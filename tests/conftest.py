@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Forces JAX onto the host CPU with 8 virtual devices so multi-device
+sharding (mesh) tests run anywhere; must be set before jax imports."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_shard_dirs(tmp_path):
+    a = tmp_path / "shard_a"
+    b = tmp_path / "shard_b"
+    a.mkdir()
+    b.mkdir()
+    return str(a), str(b)

@@ -1,0 +1,272 @@
+"""Unit tests for yadcc_tpu.common."""
+
+import numpy as np
+import pytest
+
+from yadcc_tpu.common import (
+    bloom,
+    compress,
+    consistent_hash,
+    hashing,
+    multi_chunk,
+    parse_size,
+    token_verifier,
+)
+from yadcc_tpu.common.disk_cache import DiskCache, ShardSpec
+from yadcc_tpu.common.inspect_auth import InspectAuth
+
+
+class TestHashing:
+    def test_digest_stable(self):
+        assert hashing.digest_bytes(b"abc") == hashing.digest_bytes(b"abc")
+        assert hashing.digest_bytes(b"abc") != hashing.digest_bytes(b"abd")
+
+    def test_keyed_domain_separation(self):
+        assert hashing.digest_keyed("cxx", b"a", b"b") != hashing.digest_keyed(
+            "jar", b"a", b"b"
+        )
+        # Length prefixing: ("ab","c") must differ from ("a","bc").
+        assert hashing.digest_keyed("cxx", b"ab", b"c") != hashing.digest_keyed(
+            "cxx", b"a", b"bc"
+        )
+
+    def test_digesting_writer_matches_oneshot(self):
+        w = hashing.DigestingWriter()
+        w.write(b"hello ")
+        w.write(b"world")
+        assert w.hexdigest() == hashing.digest_bytes(b"hello world")
+        assert w.bytes_written == 11
+
+    def test_digest_file(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_bytes(b"x" * 100000)
+        assert hashing.digest_file(p) == hashing.digest_bytes(b"x" * 100000)
+
+
+class TestCompress:
+    def test_roundtrip(self):
+        data = b"yadcc" * 10000
+        z = compress.compress(data)
+        assert len(z) < len(data)
+        assert compress.decompress(z) == data
+
+    def test_streaming_matches(self):
+        data = b"abcdef" * 5000
+
+        class Buf:
+            def __init__(self):
+                self.chunks = []
+
+            def write(self, d):
+                self.chunks.append(d)
+
+        buf = Buf()
+        cw = compress.CompressingWriter(buf)
+        for i in range(0, len(data), 777):
+            cw.write(data[i : i + 777])
+        cw.close()
+        assert compress.decompress(b"".join(buf.chunks)) == data
+
+    def test_try_decompress_garbage(self):
+        assert compress.try_decompress(b"not zstd") is None
+
+    def test_tee(self):
+        d1, d2 = hashing.DigestingWriter(), hashing.DigestingWriter()
+        tee = compress.TeeWriter(d1, d2)
+        tee.write(b"data")
+        assert d1.hexdigest() == d2.hexdigest()
+
+
+class TestParseSize:
+    @pytest.mark.parametrize(
+        "text,expected",
+        [
+            ("10", 10),
+            ("10k", 10240),
+            ("10K", 10240),
+            ("2M", 2 << 20),
+            ("10G", 10 << 30),
+            ("1.5G", int(1.5 * (1 << 30))),
+            ("3T", 3 << 40),
+        ],
+    )
+    def test_ok(self, text, expected):
+        assert parse_size.parse_size(text) == expected
+
+    @pytest.mark.parametrize("text", ["", "G", "10X", "-5M"])
+    def test_bad(self, text):
+        assert parse_size.try_parse_size(text) is None
+
+
+class TestConsistentHash:
+    def test_stability_under_node_add(self):
+        ring1 = consistent_hash.ConsistentHash([("a", 1), ("b", 1)])
+        ring2 = consistent_hash.ConsistentHash([("a", 1), ("b", 1), ("c", 1)])
+        keys = [f"key{i}" for i in range(2000)]
+        moved = sum(1 for k in keys if ring1.pick(k) != ring2.pick(k))
+        # Only ~1/3 of keys should move when a third node joins.
+        assert moved < len(keys) * 0.45
+
+    def test_weighting(self):
+        ring = consistent_hash.ConsistentHash([("big", 3), ("small", 1)])
+        keys = [f"key{i}" for i in range(4000)]
+        big = sum(1 for k in keys if ring.pick(k) == "big")
+        assert 0.6 < big / len(keys) < 0.9
+
+
+class TestTokenVerifier:
+    def test_empty_accepts_all(self):
+        assert token_verifier.TokenVerifier().verify("anything")
+
+    def test_membership(self):
+        v = token_verifier.TokenVerifier(["t1", "t2"])
+        assert v.verify("t1") and v.verify("t2")
+        assert not v.verify("t3") and not v.verify("")
+
+    def test_flag_parsing(self):
+        v = token_verifier.make_token_verifier_from_flag("a, b ,,c")
+        assert v.verify("a") and v.verify("b") and v.verify("c")
+        assert not v.verify("d")
+
+    def test_generate_unique(self):
+        assert token_verifier.generate_token() != token_verifier.generate_token()
+
+
+class TestMultiChunk:
+    def test_roundtrip(self):
+        chunks = [b"XX", b"0123456789", b""]
+        data = multi_chunk.make_multi_chunk(chunks)
+        assert data.startswith(b"2,10,0\r\n")
+        assert multi_chunk.try_parse_multi_chunk(data) == chunks
+
+    def test_wire_example(self):
+        # The documented example from the reference's local README.
+        assert multi_chunk.make_multi_chunk([b"XX", b"0123456789"]) == (
+            b"2,10\r\nXX0123456789"
+        )
+
+    def test_empty(self):
+        assert multi_chunk.try_parse_multi_chunk(b"\r\n") == []
+
+    @pytest.mark.parametrize(
+        "bad", [b"", b"2,3\r\nabcd", b"x\r\nab", b"5\r\nab"]
+    )
+    def test_malformed(self, bad):
+        assert multi_chunk.try_parse_multi_chunk(bad) is None
+
+
+class TestBloom:
+    def test_membership(self):
+        f = bloom.SaltedBloomFilter(num_bits=100003, num_hashes=7, salt=42)
+        keys = [f"entry-{i}" for i in range(500)]
+        f.add_many(keys)
+        assert all(f.may_contain(k) for k in keys)
+        fps = sum(f.may_contain(f"other-{i}") for i in range(2000))
+        assert fps < 10
+
+    def test_salt_changes_layout(self):
+        f1 = bloom.SaltedBloomFilter(num_bits=1009, num_hashes=3, salt=1)
+        f2 = bloom.SaltedBloomFilter(num_bits=1009, num_hashes=3, salt=2)
+        f1.add("k")
+        f2.add("k")
+        assert not np.array_equal(f1.words, f2.words)
+
+    def test_serialization_roundtrip(self):
+        f = bloom.SaltedBloomFilter(num_bits=100003, num_hashes=5, salt=7)
+        f.add_many([f"k{i}" for i in range(100)])
+        g = bloom.SaltedBloomFilter.from_bytes(f.to_bytes(), 5, 7,
+                                               num_bits=100003)
+        assert all(g.may_contain(f"k{i}") for i in range(100))
+
+    def test_fingerprints_batch(self):
+        fps = bloom.key_fingerprints(["a", "b"], salt=3)
+        assert fps.shape == (2, 2) and fps.dtype == np.uint32
+        assert tuple(fps[0]) == bloom.key_fingerprint("a", 3)
+
+
+class TestDiskCache:
+    def _mk(self, dirs, **kw):
+        return DiskCache(
+            [ShardSpec(d, capacity_bytes=1 << 20) for d in dirs], **kw
+        )
+
+    def test_put_get_remove(self, tmp_shard_dirs):
+        c = self._mk(tmp_shard_dirs)
+        assert c.try_get("k") is None
+        c.put("k", b"value")
+        assert c.try_get("k") == b"value"
+        assert c.remove("k")
+        assert c.try_get("k") is None
+
+    def test_overwrite_accounting(self, tmp_shard_dirs):
+        c = self._mk(tmp_shard_dirs)
+        c.put("k", b"a" * 100)
+        c.put("k", b"b" * 50)
+        assert c.total_bytes() == 50
+        assert c.try_get("k") == b"b" * 50
+
+    def test_purge_respects_cap(self, tmp_shard_dirs):
+        c = DiskCache([ShardSpec(tmp_shard_dirs[0], capacity_bytes=1000)])
+        for i in range(20):
+            c.put(f"k{i}", b"x" * 100)
+        assert c.total_bytes() <= 1000
+
+    def test_startup_scan_rebuilds_sizes(self, tmp_shard_dirs):
+        c1 = self._mk(tmp_shard_dirs)
+        for i in range(10):
+            c1.put(f"k{i}", b"y" * 10)
+        c2 = self._mk(tmp_shard_dirs)
+        assert c2.total_bytes() == 100
+        assert c2.entry_count() == 10
+        assert c2.try_get("k3") == b"y" * 10
+
+    def test_scanned_entries_purge_correctly(self, tmp_shard_dirs):
+        # Entries found by the startup scan must be evictable (correct
+        # path, correct accounting) and rank *older* than fresh writes.
+        import os
+        d = tmp_shard_dirs[0]
+        c1 = DiskCache([ShardSpec(d, capacity_bytes=1 << 20)])
+        c1.put("old", b"a" * 400)
+        # Backdate the file so the rescanned mtime is clearly old.
+        path = next(p for p in __import__("pathlib").Path(d).glob("*/*/*"))
+        os.utime(path, (1, 1))
+        c2 = DiskCache([ShardSpec(d, capacity_bytes=500)])
+        assert c2.total_bytes() == 400
+        c2.put("new", b"b" * 400)  # over cap -> must evict "old", not "new"
+        assert c2.try_get("new") == b"b" * 400
+        assert c2.try_get("old") is None
+        assert c2.total_bytes() == 400
+
+    def test_put_same_key_after_rescan_no_double_count(self, tmp_shard_dirs):
+        c1 = self._mk(tmp_shard_dirs)
+        c1.put("k", b"x" * 80)
+        c2 = self._mk(tmp_shard_dirs)
+        c2.put("k", b"x" * 80)
+        assert c2.total_bytes() == 80
+        assert c2.entry_count() == 1
+
+    def test_misplaced_move(self, tmp_shard_dirs):
+        a, b = tmp_shard_dirs
+        # Build with one shard, reopen with two: entries whose digest now
+        # hashes to shard b must be moved there and stay readable.
+        c1 = DiskCache([ShardSpec(a, capacity_bytes=1 << 20)])
+        for i in range(30):
+            c1.put(f"k{i}", f"v{i}".encode())
+        c2 = self._mk((a, b), on_misplaced=DiskCache.ON_MISPLACED_MOVE)
+        for i in range(30):
+            assert c2.try_get(f"k{i}") == f"v{i}".encode()
+
+
+class TestInspectAuth:
+    def test_disabled(self):
+        assert InspectAuth("").check(None)
+
+    def test_basic(self):
+        import base64
+
+        auth = InspectAuth("user:pw")
+        good = "Basic " + base64.b64encode(b"user:pw").decode()
+        assert auth.check(good)
+        assert not auth.check("Basic " + base64.b64encode(b"u:x").decode())
+        assert not auth.check(None)
+        assert not auth.check("Bearer xyz")

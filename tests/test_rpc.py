@@ -1,0 +1,150 @@
+"""Tests for the RPC layer: frames, mock:// transport, grpc transport."""
+
+import threading
+
+import pytest
+
+from yadcc_tpu import api
+from yadcc_tpu.rpc import (
+    Channel,
+    GrpcServer,
+    RpcError,
+    ServiceSpec,
+    register_mock_server,
+    unregister_mock_server,
+)
+from yadcc_tpu.rpc import transport as tp
+
+
+def make_echo_service() -> ServiceSpec:
+    spec = ServiceSpec("test.Echo")
+
+    def Echo(req, attachment, ctx):
+        ctx.response_attachment = attachment[::-1]
+        return api.scheduler.GetConfigResponse(
+            serving_daemon_token=req.token + "!"
+        )
+
+    def Fail(req, attachment, ctx):
+        raise RpcError(1003, "denied")
+
+    def Peer(req, attachment, ctx):
+        return api.scheduler.GetConfigResponse(serving_daemon_token=ctx.peer)
+
+    spec.add("Echo", api.scheduler.GetConfigRequest, Echo)
+    spec.add("Fail", api.scheduler.GetConfigRequest, Fail)
+    spec.add("Peer", api.scheduler.GetConfigRequest, Peer)
+    return spec
+
+
+class TestFrames:
+    def test_roundtrip(self):
+        frame = tp.encode_frame(7, b"meta", b"attach")
+        assert tp.decode_frame(frame) == (7, b"meta", b"attach")
+
+    def test_empty_attachment(self):
+        assert tp.decode_frame(tp.encode_frame(0, b"m"))[2] == b""
+
+
+class TestMockTransport:
+    def setup_method(self):
+        register_mock_server("echo_server", make_echo_service())
+
+    def teardown_method(self):
+        unregister_mock_server("echo_server")
+
+    def test_call(self):
+        ch = Channel("mock://echo_server")
+        resp, att = ch.call(
+            "test.Echo", "Echo",
+            api.scheduler.GetConfigRequest(token="hi"),
+            api.scheduler.GetConfigResponse,
+            attachment=b"abc",
+        )
+        assert resp.serving_daemon_token == "hi!"
+        assert att == b"cba"
+
+    def test_app_error(self):
+        ch = Channel("mock://echo_server")
+        with pytest.raises(RpcError) as ei:
+            ch.call("test.Echo", "Fail",
+                    api.scheduler.GetConfigRequest(),
+                    api.scheduler.GetConfigResponse)
+        assert ei.value.status == 1003
+
+    def test_unknown_server(self):
+        ch = Channel("mock://nope")
+        with pytest.raises(RpcError):
+            ch.call("test.Echo", "Echo",
+                    api.scheduler.GetConfigRequest(),
+                    api.scheduler.GetConfigResponse)
+
+    def test_unknown_method(self):
+        ch = Channel("mock://echo_server")
+        with pytest.raises(RpcError) as ei:
+            ch.call("test.Echo", "Nope",
+                    api.scheduler.GetConfigRequest(),
+                    api.scheduler.GetConfigResponse)
+        assert ei.value.status == tp.STATUS_METHOD_NOT_FOUND
+
+
+class TestGrpcTransport:
+    @pytest.fixture
+    def server(self):
+        srv = GrpcServer("127.0.0.1:0")
+        srv.add_service(make_echo_service())
+        srv.start()
+        yield srv
+        srv.stop(grace=0)
+
+    def test_call_with_attachment(self, server):
+        ch = Channel(f"grpc://127.0.0.1:{server.port}")
+        resp, att = ch.call(
+            "test.Echo", "Echo",
+            api.scheduler.GetConfigRequest(token="net"),
+            api.scheduler.GetConfigResponse,
+            attachment=b"payload" * 1000,
+            timeout=5,
+        )
+        assert resp.serving_daemon_token == "net!"
+        assert att == (b"payload" * 1000)[::-1]
+        ch.close()
+
+    def test_app_error_propagates(self, server):
+        ch = Channel(f"grpc://127.0.0.1:{server.port}")
+        with pytest.raises(RpcError) as ei:
+            ch.call("test.Echo", "Fail",
+                    api.scheduler.GetConfigRequest(),
+                    api.scheduler.GetConfigResponse, timeout=5)
+        assert ei.value.status == 1003
+        ch.close()
+
+    def test_peer_observed(self, server):
+        ch = Channel(f"grpc://127.0.0.1:{server.port}")
+        resp, _ = ch.call("test.Echo", "Peer",
+                          api.scheduler.GetConfigRequest(),
+                          api.scheduler.GetConfigResponse, timeout=5)
+        assert resp.serving_daemon_token.startswith("127.0.0.1:")
+        ch.close()
+
+    def test_concurrent_calls(self, server):
+        ch = Channel(f"grpc://127.0.0.1:{server.port}")
+        errors = []
+
+        def worker(i):
+            try:
+                resp, _ = ch.call(
+                    "test.Echo", "Echo",
+                    api.scheduler.GetConfigRequest(token=f"t{i}"),
+                    api.scheduler.GetConfigResponse, timeout=5)
+                assert resp.serving_daemon_token == f"t{i}!"
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        ch.close()

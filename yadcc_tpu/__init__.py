@@ -1,0 +1,13 @@
+"""yadcc_tpu — a TPU-native distributed compilation framework.
+
+A ground-up rebuild of the capabilities of Tencent/yadcc (distributed
+C++ compilation: compiler-masquerading client, delegate+servant daemons,
+lease-based central scheduler, two-level distributed compilation cache
+with Bloom-filter miss avoidance) with the control plane's policy math
+executed as batched, jitted JAX kernels — see ops/ and parallel/ for the
+device side, scheduler/ cache/ daemon/ client/ for the four programs.
+"""
+
+from .version import VERSION_FOR_UPGRADE
+
+__all__ = ["VERSION_FOR_UPGRADE"]

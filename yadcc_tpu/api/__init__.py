@@ -1,0 +1,28 @@
+"""Wire contract of the framework: protobuf messages + service names.
+
+The ``.proto`` sources live in ``protos/``; generated modules are checked
+in under ``gen/`` (refresh with ``python -m yadcc_tpu.api.build_protos``).
+This module re-exports the message classes under stable names so the rest
+of the codebase never imports ``*_pb2`` directly.
+"""
+
+from .gen import cache_pb2 as cache
+from .gen import daemon_pb2 as daemon
+from .gen import env_desc_pb2 as env_desc
+from .gen import extra_info_pb2 as extra_info
+from .gen import local_pb2 as local
+from .gen import patch_pb2 as patch
+from .gen import scheduler_pb2 as scheduler
+
+EnvironmentDesc = env_desc.EnvironmentDesc
+
+__all__ = [
+    "cache",
+    "daemon",
+    "env_desc",
+    "extra_info",
+    "local",
+    "patch",
+    "scheduler",
+    "EnvironmentDesc",
+]

@@ -1,0 +1,57 @@
+"""Regenerate the checked-in protobuf Python modules.
+
+Run from the repo root:  python -m yadcc_tpu.api.build_protos
+
+The generated ``*_pb2.py`` files under ``yadcc_tpu/api/gen/`` are
+committed so importing the package never requires protoc at runtime;
+this script exists to refresh them after editing the ``.proto`` sources.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import subprocess
+import sys
+
+API_DIR = pathlib.Path(__file__).resolve().parent
+PROTO_DIR = API_DIR / "protos"
+GEN_DIR = API_DIR / "gen"
+
+PROTOS = [
+    "env_desc.proto",
+    "patch.proto",
+    "extra_info.proto",
+    "scheduler.proto",
+    "daemon.proto",
+    "cache.proto",
+    "local.proto",
+]
+
+
+def build() -> None:
+    GEN_DIR.mkdir(exist_ok=True)
+    (GEN_DIR / "__init__.py").write_text("")
+    cmd = [
+        "protoc",
+        f"-I{PROTO_DIR}",
+        f"--python_out={GEN_DIR}",
+        *[str(PROTO_DIR / p) for p in PROTOS],
+    ]
+    subprocess.run(cmd, check=True)
+    # protoc emits absolute imports (``import patch_pb2``); rewrite them to
+    # package-relative so the modules work from inside yadcc_tpu.api.gen.
+    for py in GEN_DIR.glob("*_pb2.py"):
+        src = py.read_text()
+        src = re.sub(
+            r"^import (\w+_pb2) as",
+            r"from . import \1 as",
+            src,
+            flags=re.MULTILINE,
+        )
+        py.write_text(src)
+    print(f"generated {len(PROTOS)} modules into {GEN_DIR}")
+
+
+if __name__ == "__main__":
+    sys.exit(build())

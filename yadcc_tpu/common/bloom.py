@@ -1,0 +1,129 @@
+"""Salted Bloom filter over a numpy bit-array.
+
+Host-side twin of the device probe kernel in yadcc_tpu/ops/bloom.py: both
+sides derive probe indices identically (uint32 double hashing from a
+salted xxhash64 fingerprint), so a filter built here can be shipped to
+the device (or to a remote daemon, zstd-compressed) and probed there
+bit-for-bit compatibly.
+
+Parity: reference flare SaltedBloomFilter as used by
+yadcc/cache/bloom_filter_generator.h:64-68 (27,584,639 bits / 10 hashes,
+sized for 1M keys at 1e-5 false-positive rate) and the client-side
+replica in yadcc/daemon/local/distributed_cache_reader.h:32-56.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple
+
+import numpy as np
+import xxhash
+
+# Same constants as the reference's generator.
+DEFAULT_NUM_BITS = 27_584_639
+DEFAULT_NUM_HASHES = 10
+
+
+def key_fingerprint(key: str, salt: int) -> Tuple[int, int]:
+    """(h1, h2) uint32 pair for double hashing; h2 forced odd so the
+    probe sequence cycles through the whole ring."""
+    fp = xxhash.xxh64_intdigest(key.encode(), seed=salt & 0xFFFFFFFFFFFFFFFF)
+    h1 = fp & 0xFFFFFFFF
+    h2 = ((fp >> 32) | 1) & 0xFFFFFFFF
+    return h1, h2
+
+
+def key_fingerprints(keys: Iterable[str], salt: int) -> np.ndarray:
+    """[N, 2] uint32 fingerprint array for batched (device) probing."""
+    out = np.array([key_fingerprint(k, salt) for k in keys], dtype=np.uint64)
+    return out.reshape(-1, 2).astype(np.uint32)
+
+
+def probe_indices(h1: int, h2: int, num_hashes: int, num_bits: int) -> np.ndarray:
+    i = np.arange(num_hashes, dtype=np.uint32)
+    # uint32 wrap-around then mod num_bits — the device kernel does the
+    # exact same arithmetic, keep in sync with ops/bloom.py.
+    return ((np.uint32(h1) + i * np.uint32(h2)) % np.uint32(num_bits)).astype(
+        np.int64
+    )
+
+
+class SaltedBloomFilter:
+    """Bit-array Bloom filter with a per-instance salt.
+
+    The salt makes filters from different server generations mutually
+    incompatible on purpose: a client syncing against a rebuilt filter
+    must do a full re-fetch rather than silently mixing bit positions.
+    """
+
+    def __init__(
+        self,
+        num_bits: int = DEFAULT_NUM_BITS,
+        num_hashes: int = DEFAULT_NUM_HASHES,
+        salt: int = 0,
+        words: np.ndarray | None = None,
+    ):
+        self.num_bits = num_bits
+        self.num_hashes = num_hashes
+        self.salt = salt
+        nwords = (num_bits + 31) // 32
+        if words is None:
+            self._words = np.zeros(nwords, dtype=np.uint32)
+        else:
+            assert words.shape == (nwords,)
+            self._words = words.astype(np.uint32, copy=False)
+
+    # -- mutation ---------------------------------------------------------
+
+    def add(self, key: str) -> None:
+        h1, h2 = key_fingerprint(key, self.salt)
+        idx = probe_indices(h1, h2, self.num_hashes, self.num_bits)
+        np.bitwise_or.at(
+            self._words, idx >> 5, (np.uint32(1) << (idx & 31).astype(np.uint32))
+        )
+
+    def add_many(self, keys: Iterable[str]) -> None:
+        for k in keys:
+            self.add(k)
+
+    # -- queries ----------------------------------------------------------
+
+    def may_contain(self, key: str) -> bool:
+        h1, h2 = key_fingerprint(key, self.salt)
+        idx = probe_indices(h1, h2, self.num_hashes, self.num_bits)
+        bits = (self._words[idx >> 5] >> (idx & 31).astype(np.uint32)) & 1
+        return bool(bits.all())
+
+    def fill_ratio(self) -> float:
+        ones = int(np.unpackbits(self._words.view(np.uint8)).sum())
+        return ones / (len(self._words) * 32)
+
+    # -- (de)serialization -------------------------------------------------
+
+    @property
+    def words(self) -> np.ndarray:
+        return self._words
+
+    def to_bytes(self) -> bytes:
+        return self._words.tobytes()
+
+    @classmethod
+    def from_bytes(
+        cls,
+        data: bytes,
+        num_hashes: int,
+        salt: int,
+        num_bits: int | None = None,
+    ) -> "SaltedBloomFilter":
+        words = np.frombuffer(data, dtype=np.uint32).copy()
+        if num_bits is None:
+            # The wire protocol doesn't carry num_bits (parity with the
+            # reference, where it's a shared constant).  Inferring
+            # len(words)*32 for arbitrary sizes would silently disagree
+            # with the builder's modulus, so only the default is inferable.
+            if (DEFAULT_NUM_BITS + 31) // 32 != len(words):
+                raise ValueError(
+                    "num_bits must be given for non-default filter sizes"
+                )
+            num_bits = DEFAULT_NUM_BITS
+        return cls(num_bits, num_hashes, salt, words)

@@ -1,0 +1,89 @@
+"""zstd helpers (one-shot + streaming), parity with the reference's
+flare compression and the client's zstd output stream
+(yadcc/client/common/compress.{h,cc}, output_stream.{h,cc})."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import zstandard
+
+# Reference tunes for throughput, not ratio: zstd eats ~15% of client CPU
+# at the default level (yadcc/doc/rationale.md:94).
+_LEVEL = 3
+
+# zstandard (de)compressor objects are not safe for concurrent use from
+# multiple threads, and the daemons serve RPCs on thread pools — keep one
+# per thread.
+import threading
+
+_tls = threading.local()
+
+
+def _ctx() -> tuple:
+    pair = getattr(_tls, "pair", None)
+    if pair is None:
+        pair = (
+            zstandard.ZstdCompressor(level=_LEVEL),
+            zstandard.ZstdDecompressor(),
+        )
+        _tls.pair = pair
+    return pair
+
+
+def compress(data: bytes) -> bytes:
+    return _ctx()[0].compress(data)
+
+
+def decompress(data: bytes, max_output_size: int = 1 << 31) -> bytes:
+    # Frames produced by streaming compressors have no content size in the
+    # header, so a cap is required.
+    return _ctx()[1].decompress(data, max_output_size=max_output_size)
+
+
+def try_decompress(data: bytes) -> Optional[bytes]:
+    try:
+        return decompress(data)
+    except zstandard.ZstdError:
+        return None
+
+
+class CompressingWriter:
+    """Streaming zstd sink chaining into a downstream writer; composable
+    with hashing.DigestingWriter to form the client's single-pass
+    preprocess -> (digest, zstd) tee."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self._obj = zstandard.ZstdCompressor(level=_LEVEL).compressobj()
+        self._closed = False
+
+    def write(self, data: bytes) -> int:
+        out = self._obj.compress(data)
+        if out:
+            self._sink.write(out)
+        return len(data)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            out = self._obj.flush()
+            if out:
+                self._sink.write(out)
+
+
+class TeeWriter:
+    """Fan a write out to several sinks (ForwardingOutputStream parity)."""
+
+    def __init__(self, *sinks):
+        self._sinks = sinks
+
+    def write(self, data: bytes) -> int:
+        for s in self._sinks:
+            s.write(data)
+        return len(data)
+
+
+def decompress_iter(chunks: Iterable[bytes]) -> bytes:
+    obj = _ctx()[1].decompressobj()
+    return b"".join(obj.decompress(c) for c in chunks)

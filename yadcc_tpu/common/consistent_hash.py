@@ -1,0 +1,37 @@
+"""Weighted consistent-hash ring, parity with reference
+yadcc/common/consistent_hash.h:33-71 (100 virtual nodes per weight unit).
+Used by the disk cache to pick a shard directory stably as shards come
+and go."""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Sequence, Tuple
+
+import xxhash
+
+_VNODES_PER_WEIGHT = 100
+
+
+def _hash(data: str) -> int:
+    return xxhash.xxh64_intdigest(data)
+
+
+class ConsistentHash:
+    def __init__(self, nodes: Sequence[Tuple[str, int]]):
+        """nodes: (name, weight) pairs; weight units map to 100 vnodes."""
+        ring: List[Tuple[int, str]] = []
+        for name, weight in nodes:
+            for i in range(weight * _VNODES_PER_WEIGHT):
+                ring.append((_hash(f"{name}#{i}"), name))
+        ring.sort()
+        self._points = [p for p, _ in ring]
+        self._names = [n for _, n in ring]
+
+    def pick(self, key: str) -> str:
+        if not self._points:
+            raise ValueError("empty ring")
+        idx = bisect.bisect_right(self._points, _hash(key))
+        if idx == len(self._points):
+            idx = 0
+        return self._names[idx]

@@ -1,0 +1,52 @@
+"""Small filesystem helpers, parity with reference yadcc/common/{io,dir}.cc."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from pathlib import Path
+from typing import Dict, List
+
+
+def read_all(path: str | os.PathLike) -> bytes:
+    with open(path, "rb") as fp:
+        return fp.read()
+
+
+def write_all(path: str | os.PathLike, data: bytes) -> None:
+    with open(path, "wb") as fp:
+        fp.write(data)
+
+
+def mkdirs(path: str | os.PathLike) -> None:
+    Path(path).mkdir(parents=True, exist_ok=True)
+
+
+def remove_tree(path: str | os.PathLike) -> None:
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def enumerate_files(root: str | os.PathLike) -> List[str]:
+    """Relative paths of all regular files under root."""
+    rootp = Path(root)
+    return sorted(
+        str(p.relative_to(rootp))
+        for p in rootp.rglob("*")
+        if p.is_file()
+    )
+
+
+def read_tree(root: str | os.PathLike) -> Dict[str, bytes]:
+    """relative path -> content for all files under root (used to collect
+    a compilation workspace's outputs)."""
+    rootp = Path(root)
+    return {
+        str(p.relative_to(rootp)): p.read_bytes()
+        for p in rootp.rglob("*")
+        if p.is_file()
+    }
+
+
+def file_mtime_size(path: str | os.PathLike) -> tuple[int, int]:
+    st = os.stat(path)
+    return int(st.st_mtime), st.st_size

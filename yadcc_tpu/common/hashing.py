@@ -1,0 +1,74 @@
+"""Content digests.
+
+The reference hashes everything with BLAKE3 (~3 GB/s, chosen over MD5/SHA1
+for CPU budget — yadcc/doc/client/cxx.md:61-68).  CPython ships no BLAKE3,
+so this framework standardizes on BLAKE2b-256 from hashlib, which is in
+the same performance class and, like BLAKE3, is keyed/salted-capable.
+Digest strings are lowercase hex and opaque to every protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import BinaryIO
+
+_DIGEST_SIZE = 32
+
+
+def new_digest():
+    return hashlib.blake2b(digest_size=_DIGEST_SIZE)
+
+
+def digest_bytes(*parts: bytes) -> str:
+    """Digest of the concatenation of `parts`, hex-encoded."""
+    h = new_digest()
+    for p in parts:
+        h.update(p)
+    return h.hexdigest()
+
+
+def digest_keyed(domain: str, *parts: bytes) -> str:
+    """Domain-separated digest: each part is length-prefixed so component
+    boundaries can't be confused (unlike plain concatenation)."""
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE, person=domain.encode()[:16])
+    for p in parts:
+        h.update(len(p).to_bytes(8, "little"))
+        h.update(p)
+    return h.hexdigest()
+
+
+def digest_stream(fp: BinaryIO, chunk_size: int = 1 << 20) -> str:
+    h = new_digest()
+    while True:
+        chunk = fp.read(chunk_size)
+        if not chunk:
+            break
+        h.update(chunk)
+    return h.hexdigest()
+
+
+def digest_file(path: str | os.PathLike) -> str:
+    with open(path, "rb") as fp:
+        return digest_stream(fp)
+
+
+class DigestingWriter:
+    """Output-stream sink that digests everything written through it.
+
+    Mirrors the client's Blake3OutputStream (reference
+    yadcc/client/common/output_stream.{h,cc}) so preprocessing can stream
+    into compression and hashing in a single pass.
+    """
+
+    def __init__(self):
+        self._h = new_digest()
+        self.bytes_written = 0
+
+    def write(self, data: bytes) -> int:
+        self._h.update(data)
+        self.bytes_written += len(data)
+        return len(data)
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()

@@ -1,0 +1,41 @@
+r"""Multi-chunk framing codec shared by the client and the local daemon.
+
+Format (reference yadcc/daemon/local/README.md:13-27): a first line of
+comma-separated decimal chunk lengths terminated by \r\n, followed by the
+chunks' bytes concatenated:
+
+    b"2,10\r\nXX0123456789"  ==  [b"XX", b"0123456789"]
+
+An empty chunk list encodes as just b"\r\n".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def make_multi_chunk(chunks: Sequence[bytes]) -> bytes:
+    header = ",".join(str(len(c)) for c in chunks).encode()
+    return header + b"\r\n" + b"".join(chunks)
+
+
+def try_parse_multi_chunk(data: bytes) -> Optional[List[bytes]]:
+    eol = data.find(b"\r\n")
+    if eol < 0:
+        return None
+    header = data[:eol]
+    body = memoryview(data)[eol + 2 :]
+    if not header:
+        return [] if len(body) == 0 else None
+    try:
+        lengths = [int(x) for x in header.split(b",")]
+    except ValueError:
+        return None
+    if any(l < 0 for l in lengths) or sum(lengths) != len(body):
+        return None
+    chunks: List[bytes] = []
+    off = 0
+    for l in lengths:
+        chunks.append(bytes(body[off : off + l]))
+        off += l
+    return chunks

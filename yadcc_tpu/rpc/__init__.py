@@ -1,0 +1,40 @@
+"""Transport layer for the control plane.
+
+The reference runs on the flare RPC framework (protobuf services with
+first-class *attachments* so bulk bytes skip message serialization, and
+``mock://`` channels for in-process service fakes in tests — reference
+yadcc/daemon/local/distributed_task_dispatcher_test.cc:33-35).  This
+framework keeps both ideas with two interchangeable transports:
+
+* ``grpc://host:port`` — production transport over grpc's generic (bytes
+  in / bytes out) API, with a tiny length-prefixed frame carrying the
+  serialized message plus an optional attachment.
+* ``mock://name`` — a process-local registry of servers, used by every
+  unit test to fake the scheduler / cache / peer-servant services without
+  sockets.
+
+Services are plain objects exposing ``service_name`` and a ``methods``
+table; the same object can be mounted on either transport.
+"""
+
+from .transport import (
+    Channel,
+    RpcContext,
+    RpcError,
+    ServiceSpec,
+    method,
+    register_mock_server,
+    unregister_mock_server,
+)
+from .grpc_transport import GrpcServer
+
+__all__ = [
+    "Channel",
+    "GrpcServer",
+    "RpcContext",
+    "RpcError",
+    "ServiceSpec",
+    "method",
+    "register_mock_server",
+    "unregister_mock_server",
+]

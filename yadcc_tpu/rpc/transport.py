@@ -1,0 +1,188 @@
+"""Transport-agnostic RPC core: frames, service specs, channels.
+
+Wire frame (both directions, same on grpc and raw usage):
+
+    [u32 status][u32 meta_len][meta bytes][attachment bytes...]
+
+``status`` is 0 on success; non-zero values are application status codes
+(the per-service ``*_STATUS_*`` enums in yadcc_tpu/api).  Attachments are
+whatever bytes follow the message — the transport never copies them into
+a protobuf field (reference flare attachments, e.g. yadcc/api/cache.proto
+comment on TryGetEntry).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+_HEADER = struct.Struct("<II")
+
+
+class RpcError(Exception):
+    """Application-level RPC failure with a numeric status code."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"rpc failed: status={status} {message}")
+        self.status = status
+        self.message = message
+
+
+# Transport-level status codes (distinct range from app statuses).
+STATUS_TRANSPORT_FAILURE = 1
+STATUS_METHOD_NOT_FOUND = 2
+STATUS_TIMEOUT = 3
+
+
+@dataclass
+class RpcContext:
+    """Per-call server-side context."""
+
+    # Peer address as observed by the transport ("ip:port"), used e.g.
+    # for the scheduler's NAT detection (observed vs reported endpoint).
+    peer: str = ""
+    # Response attachment, set by the handler.
+    response_attachment: bytes = b""
+
+
+# A handler takes (request_message, request_attachment, context) and
+# returns the response message (attachment goes via ctx).
+Handler = Callable[[object, bytes, RpcContext], object]
+
+
+@dataclass
+class MethodSpec:
+    name: str
+    request_cls: type
+    handler: Handler
+
+
+@dataclass
+class ServiceSpec:
+    """A mountable service: name plus method table."""
+
+    service_name: str
+    methods: Dict[str, MethodSpec] = field(default_factory=dict)
+
+    def add(self, name: str, request_cls: type, handler: Handler) -> None:
+        self.methods[name] = MethodSpec(name, request_cls, handler)
+
+
+def method(spec: ServiceSpec, request_cls: type):
+    """Decorator registering a bound method on a ServiceSpec by name."""
+
+    def deco(fn):
+        spec.add(fn.__name__, request_cls, fn)
+        return fn
+
+    return deco
+
+
+def encode_frame(status: int, meta: bytes, attachment: bytes = b"") -> bytes:
+    return _HEADER.pack(status, len(meta)) + meta + attachment
+
+
+def decode_frame(data: bytes) -> Tuple[int, bytes, bytes]:
+    status, meta_len = _HEADER.unpack_from(data)
+    off = _HEADER.size
+    return status, data[off : off + meta_len], data[off + meta_len :]
+
+
+def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:
+    """Server-side: decode a request frame, run the handler, encode reply.
+
+    Never raises: malformed frames, undecodable messages and handler
+    crashes all turn into status frames, so mock:// and grpc:// expose
+    identical failure semantics to callers.
+    """
+    ms = spec.methods.get(name)
+    if ms is None:
+        return encode_frame(STATUS_METHOD_NOT_FOUND, b"")
+    try:
+        _, meta, attachment = decode_frame(data)
+        req = ms.request_cls.FromString(meta)
+    except Exception as e:
+        return encode_frame(STATUS_TRANSPORT_FAILURE,
+                            f"malformed request: {e!r}".encode())
+    ctx = RpcContext(peer=peer)
+    try:
+        resp = ms.handler(req, attachment, ctx)
+    except RpcError as e:
+        return encode_frame(e.status, e.message.encode())
+    except Exception as e:
+        return encode_frame(STATUS_TRANSPORT_FAILURE,
+                            f"handler error: {e!r}".encode())
+    return encode_frame(0, resp.SerializeToString(), ctx.response_attachment)
+
+
+# --------------------------------------------------------------------------
+# mock:// transport — in-process server registry for tests.
+# --------------------------------------------------------------------------
+
+_mock_servers: Dict[str, Dict[str, ServiceSpec]] = {}
+_mock_lock = threading.Lock()
+
+
+def register_mock_server(name: str, *services: ServiceSpec) -> None:
+    with _mock_lock:
+        _mock_servers[name] = {s.service_name: s for s in services}
+
+
+def unregister_mock_server(name: str) -> None:
+    with _mock_lock:
+        _mock_servers.pop(name, None)
+
+
+class Channel:
+    """Client-side channel; scheme-dispatched factory.
+
+    ``Channel("grpc://10.0.0.1:8336")`` or ``Channel("mock://scheduler")``.
+    A bare "host:port" is treated as grpc.
+    """
+
+    def __new__(cls, uri: str):
+        if cls is not Channel:
+            return super().__new__(cls)
+        # Return the concrete subclass instance; Python's call protocol
+        # then runs its __init__ exactly once (do NOT call it here).
+        if uri.startswith("mock://"):
+            return object.__new__(_MockChannel)
+        from .grpc_transport import GrpcChannel
+
+        return object.__new__(GrpcChannel)
+
+    def call(
+        self,
+        service: str,
+        method_name: str,
+        request,
+        response_cls: type,
+        attachment: bytes = b"",
+        timeout: Optional[float] = None,
+    ) -> Tuple[object, bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _MockChannel(Channel):
+    def __init__(self, uri: str):
+        self._name = uri[len("mock://") :]
+
+    def call(self, service, method_name, request, response_cls,
+             attachment=b"", timeout=None):
+        with _mock_lock:
+            services = _mock_servers.get(self._name)
+        if services is None or service not in services:
+            raise RpcError(STATUS_TRANSPORT_FAILURE,
+                           f"no mock server for {self._name}/{service}")
+        frame = encode_frame(0, request.SerializeToString(), attachment)
+        reply = dispatch_frame(services[service], method_name, frame,
+                               peer="127.0.0.1:0")
+        status, meta, att = decode_frame(reply)
+        if status != 0:
+            raise RpcError(status, meta.decode(errors="replace"))
+        return response_cls.FromString(meta), att

@@ -1,0 +1,41 @@
+"""Leveled logging with rate-limited variants.
+
+Parity with the reference's FLARE_LOG_*_EVERY_SECOND macros
+(e.g. yadcc/scheduler/task_dispatcher.cc:150) and the client's
+zero-dependency stderr logger (yadcc/client/common/logging.{h,cc})."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from typing import Dict, Tuple
+
+_configured = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("YTPU_LOG_LEVEL", "INFO").upper()
+        logging.basicConfig(
+            stream=sys.stderr,
+            level=getattr(logging, level, logging.INFO),
+            format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+        )
+        _configured = True
+    return logging.getLogger(name)
+
+
+_last_emit: Dict[Tuple[str, str], float] = {}
+
+
+def log_every_n_seconds(
+    logger: logging.Logger, level: int, key: str, msg: str, n: float = 1.0
+) -> None:
+    now = time.monotonic()
+    k = (logger.name, key)
+    if now - _last_emit.get(k, -1e9) >= n:
+        _last_emit[k] = now
+        logger.log(level, msg)

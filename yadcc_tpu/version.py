@@ -1,0 +1,15 @@
+"""Protocol version ledger.
+
+Parity with reference yadcc/daemon/common_flags.cc:41-63: a monotonically
+increasing integer, checked by the scheduler (--min_daemon_version) and
+carried in grant requests, gates protocol-incompatible daemons out of the
+pool.  Bump on every wire-visible change and record it here.
+
+History:
+  1: initial wire protocol of the TPU-native rebuild.
+"""
+
+VERSION_FOR_UPGRADE = 1
+
+# Human-readable build stamp served by /local/get_version.
+BUILT_AT = "yadcc-tpu dev"
