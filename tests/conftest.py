@@ -5,12 +5,22 @@ sharding (mesh) tests run anywhere; must be set before jax imports."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unit tests must never touch the real TPU: they'd contend with other
+# clients for the single chip (two clients wedge the device tunnel).
+# The environment may import jax at interpreter startup (sitecustomize)
+# with JAX_PLATFORMS preset to the accelerator, so setting the env var
+# here is too late — update jax's config directly, which takes effect
+# as long as no backend has been initialized yet.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

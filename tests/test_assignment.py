@@ -1,0 +1,226 @@
+"""Golden tests: the jitted assignment kernel vs the greedy CPU oracle,
+plus the sharded (8-device) variant vs both."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yadcc_tpu.models.cost import DEFAULT_COST_MODEL
+from yadcc_tpu.ops import assignment as asn
+from yadcc_tpu.parallel import mesh as pmesh
+
+
+def random_pool_np(rng, s, e_words=8):
+    alive = rng.random(s) < 0.8
+    capacity = rng.integers(0, 32, s).astype(np.int32)
+    running = np.minimum(
+        rng.integers(0, 32, s), capacity
+    ).astype(np.int32)
+    return {
+        "alive": alive,
+        "capacity": capacity,
+        "running": running,
+        "dedicated": rng.random(s) < 0.3,
+        "version": rng.integers(1, 5, s).astype(np.int32),
+        "env_bitmap": rng.integers(
+            0, 2**32, (s, e_words), dtype=np.uint64
+        ).astype(np.uint32),
+    }
+
+
+def to_pool_arrays(p):
+    return asn.PoolArrays(
+        alive=jnp.asarray(p["alive"]),
+        capacity=jnp.asarray(p["capacity"]),
+        running=jnp.asarray(p["running"]),
+        dedicated=jnp.asarray(p["dedicated"]),
+        version=jnp.asarray(p["version"]),
+        env_bitmap=jnp.asarray(p["env_bitmap"]),
+    )
+
+
+def random_tasks(rng, t, s, n_envs):
+    return [
+        (
+            int(rng.integers(0, n_envs)),
+            int(rng.integers(1, 4)),
+            int(rng.integers(-1, s)),
+        )
+        for _ in range(t)
+    ]
+
+
+class TestKernelVsOracle:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_matches_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        s, t = 64, 100
+        pool_np = random_pool_np(rng, s)
+        tasks = random_tasks(rng, t, s, n_envs=256)
+
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        expect = asn.greedy_assign(oracle_pool, tasks)
+
+        pool = to_pool_arrays(pool_np)
+        batch = asn.make_batch(
+            [x[0] for x in tasks],
+            [x[1] for x in tasks],
+            [x[2] for x in tasks],
+            pad_to=128,
+        )
+        picks, running = asn.assign_batch(pool, batch)
+        assert list(np.asarray(picks[:t])) == expect
+        assert np.array_equal(
+            np.asarray(running), oracle_pool["running"]
+        )
+        # Padding rows must not consume capacity.
+        assert all(np.asarray(picks[t:]) == asn.NO_PICK)
+
+    def test_capacity_exhaustion(self):
+        # One servant, capacity 2: exactly two grants out of five asks.
+        pool = asn.make_pool(4, 64)
+        pool = pool._replace(
+            alive=jnp.asarray([True, False, False, False]),
+            capacity=jnp.asarray([2, 0, 0, 0], jnp.int32),
+            version=jnp.asarray([1, 0, 0, 0], jnp.int32),
+            env_bitmap=jnp.zeros((4, 2), jnp.uint32).at[0, 0].set(1),
+        )
+        batch = asn.make_batch([0] * 5, [1] * 5, [-1] * 5, pad_to=8)
+        picks, running = asn.assign_batch(pool, batch)
+        picks = np.asarray(picks[:5])
+        assert list(picks) == [0, 0, asn.NO_PICK, asn.NO_PICK, asn.NO_PICK]
+        assert int(running[0]) == 2
+
+    def test_prefer_dedicated_under_half_load(self):
+        # Servant 0: user, idle. Servant 1: dedicated, 40% loaded.
+        # Reference policy picks the dedicated one despite higher util.
+        pool = asn.make_pool(2, 64)
+        pool = pool._replace(
+            alive=jnp.asarray([True, True]),
+            capacity=jnp.asarray([10, 10], jnp.int32),
+            running=jnp.asarray([0, 4], jnp.int32),
+            dedicated=jnp.asarray([False, True]),
+            version=jnp.ones(2, jnp.int32),
+            env_bitmap=jnp.ones((2, 2), jnp.uint32),
+        )
+        batch = asn.make_batch([0], [1], [-1], pad_to=4)
+        picks, _ = asn.assign_batch(pool, batch)
+        assert int(picks[0]) == 1
+
+    def test_dedicated_over_half_load_competes_on_util(self):
+        # Dedicated at 60%: preference gone; idle user node wins.
+        pool = asn.make_pool(2, 64)
+        pool = pool._replace(
+            alive=jnp.asarray([True, True]),
+            capacity=jnp.asarray([10, 10], jnp.int32),
+            running=jnp.asarray([0, 6], jnp.int32),
+            dedicated=jnp.asarray([False, True]),
+            version=jnp.ones(2, jnp.int32),
+            env_bitmap=jnp.ones((2, 2), jnp.uint32),
+        )
+        batch = asn.make_batch([0], [1], [-1], pad_to=4)
+        picks, _ = asn.assign_batch(pool, batch)
+        assert int(picks[0]) == 0
+
+    def test_self_avoidance(self):
+        pool = asn.make_pool(2, 64)
+        pool = pool._replace(
+            alive=jnp.asarray([True, True]),
+            capacity=jnp.asarray([10, 10], jnp.int32),
+            running=jnp.asarray([0, 9], jnp.int32),
+            version=jnp.ones(2, jnp.int32),
+            env_bitmap=jnp.ones((2, 2), jnp.uint32),
+        )
+        # Requestor IS slot 0 (the otherwise-best pick) -> must go to 1.
+        batch = asn.make_batch([0], [1], [0], pad_to=4)
+        picks, _ = asn.assign_batch(pool, batch)
+        assert int(picks[0]) == 1
+
+    def test_version_gate(self):
+        pool = asn.make_pool(1, 64)
+        pool = pool._replace(
+            alive=jnp.asarray([True]),
+            capacity=jnp.asarray([10], jnp.int32),
+            version=jnp.asarray([3], jnp.int32),
+            env_bitmap=jnp.ones((1, 2), jnp.uint32),
+        )
+        ok, _ = asn.assign_batch(
+            pool, asn.make_batch([0], [3], [-1], pad_to=4))
+        too_new, _ = asn.assign_batch(
+            pool, asn.make_batch([0], [4], [-1], pad_to=4))
+        assert int(ok[0]) == 0
+        assert int(too_new[0]) == asn.NO_PICK
+
+
+class TestShardedAssign:
+    def test_matches_single_device(self):
+        mesh = pmesh.make_mesh(8)
+        rng = np.random.default_rng(7)
+        s, t = 128, 64  # 16 servant slots per device
+        pool_np = random_pool_np(rng, s)
+        tasks = random_tasks(rng, t, s, n_envs=256)
+
+        pool = to_pool_arrays(pool_np)
+        batch = asn.make_batch(
+            [x[0] for x in tasks],
+            [x[1] for x in tasks],
+            [x[2] for x in tasks],
+            pad_to=64,
+        )
+        single_picks, single_running = asn.assign_batch(pool, batch)
+
+        fn = pmesh.sharded_assign_fn(mesh)
+        sharded_pool = pmesh.shard_pool(pool, mesh)
+        picks, running = fn(sharded_pool, batch)
+        assert np.array_equal(np.asarray(picks), np.asarray(single_picks))
+        assert np.array_equal(np.asarray(running), np.asarray(single_running))
+
+
+class TestShardedBloom:
+    def test_matches_host(self):
+        from yadcc_tpu.common import bloom
+
+        f = bloom.SaltedBloomFilter(num_bits=1 << 20, num_hashes=7, salt=5)
+        keys = [f"key-{i}" for i in range(512)]
+        f.add_many(keys[:256])
+
+        mesh = pmesh.make_mesh(8)
+        fn = pmesh.sharded_bloom_probe_fn(
+            mesh, num_bits=f.num_bits, num_hashes=f.num_hashes)
+        fps = bloom.key_fingerprints(keys, salt=5)
+        got = np.asarray(fn(jnp.asarray(f.words), jnp.asarray(fps)))
+        want = np.array([f.may_contain(k) for k in keys])
+        assert np.array_equal(got, want)
+        assert got[:256].all()
+
+
+class TestDeviceBloomKernel:
+    def test_matches_host_single_device(self):
+        from yadcc_tpu.common import bloom
+        from yadcc_tpu.ops import bloom_probe
+
+        f = bloom.SaltedBloomFilter(num_bits=999983, num_hashes=10, salt=9)
+        keys = [f"obj-{i}" for i in range(300)]
+        f.add_many(keys[:100])
+        fps = bloom.key_fingerprints(keys, salt=9)
+        got = np.asarray(
+            bloom_probe.bloom_may_contain(
+                jnp.asarray(f.words), jnp.asarray(fps),
+                num_bits=f.num_bits, num_hashes=f.num_hashes))
+        want = np.array([f.may_contain(k) for k in keys])
+        assert np.array_equal(got, want)
+
+    def test_scatter_add_matches_host_build(self):
+        from yadcc_tpu.common import bloom
+        from yadcc_tpu.ops import bloom_probe
+
+        host = bloom.SaltedBloomFilter(num_bits=4099, num_hashes=5, salt=3)
+        keys = [f"x{i}" for i in range(200)]
+        host.add_many(keys)
+        fps = bloom.key_fingerprints(keys, salt=3)
+        dev = bloom_probe.bloom_scatter_add(
+            jnp.zeros_like(jnp.asarray(host.words)), jnp.asarray(fps),
+            num_bits=4099, num_hashes=5)
+        assert np.array_equal(np.asarray(dev), host.words)

@@ -1,0 +1,329 @@
+"""TaskDispatcher + SchedulerService tests.
+
+Scenario parity with reference yadcc/scheduler/task_dispatcher_test.cc
+(lease expiry -> KeepTaskAlive fails -> zombie reported back; policy
+tests PreferDedicated and LoadBalanceCase) using a virtual clock instead
+of real sleeps, plus service-level tests over the mock transport.
+"""
+
+import threading
+import time
+
+import pytest
+
+from yadcc_tpu import api
+from yadcc_tpu.common.token_verifier import TokenVerifier
+from yadcc_tpu.rpc import Channel, RpcError, register_mock_server, \
+    unregister_mock_server
+from yadcc_tpu.scheduler.policy import GreedyCpuPolicy, JaxBatchedPolicy
+from yadcc_tpu.scheduler.service import SchedulerService, \
+    ServingDaemonTokenRoll
+from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+from yadcc_tpu.utils.clock import VirtualClock
+
+ENV = "deadbeef" * 8
+ENV2 = "cafebabe" * 8
+
+
+def make_servant(location, capacity=16, dedicated=False, envs=(ENV,),
+                 version=1, nprocs=32, mem=64 << 30, load=0):
+    return ServantInfo(
+        location=location,
+        version=version,
+        num_processors=nprocs,
+        current_load=load,
+        dedicated=dedicated,
+        capacity=capacity,
+        total_memory=mem,
+        memory_available=mem,
+        env_digests=tuple(envs),
+    )
+
+
+@pytest.fixture(params=["greedy_cpu", "jax_batched"])
+def dispatcher(request):
+    clock = VirtualClock(start=100.0)
+    policy = (GreedyCpuPolicy() if request.param == "greedy_cpu"
+              else JaxBatchedPolicy(max_servants=64, max_batch=32))
+    d = TaskDispatcher(
+        policy, max_servants=64, max_envs=64, clock=clock,
+        batch_window_s=0.0, start_dispatch_thread=True,
+    )
+    d.clock = clock
+    yield d
+    d.stop()
+
+
+class TestGrantLifecycle:
+    def test_basic_grant_and_free(self, dispatcher):
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 10)
+        grants = dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=2.0)
+        assert len(grants) == 1
+        gid, loc = grants[0]
+        assert loc == "10.0.0.1:8335"
+        assert dispatcher.keep_task_alive([gid], 15.0) == [True]
+        dispatcher.free_task([gid])
+        assert dispatcher.keep_task_alive([gid], 15.0) == [False]
+
+    def test_no_eligible_environment_times_out(self, dispatcher):
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 10)
+        grants = dispatcher.wait_for_starting_new_task(
+            ENV2, timeout_s=0.3)
+        assert grants == []
+
+    def test_immediate_plus_prefetch(self, dispatcher):
+        dispatcher.keep_servant_alive(
+            make_servant("10.0.0.1:8335", capacity=8), 10)
+        grants = dispatcher.wait_for_starting_new_task(
+            ENV, immediate=2, prefetch=2, timeout_s=2.0)
+        assert len(grants) == 4
+
+    def test_prefetch_not_granted_under_scarcity(self, dispatcher):
+        dispatcher.keep_servant_alive(
+            make_servant("10.0.0.1:8335", capacity=2), 10)
+        grants = dispatcher.wait_for_starting_new_task(
+            ENV, immediate=2, prefetch=5, timeout_s=0.5)
+        assert len(grants) == 2  # immediate satisfied, prefetch dropped
+
+    def test_lease_expiry_creates_zombie(self, dispatcher):
+        clock = dispatcher.clock
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 1000)
+        (gid, _), = dispatcher.wait_for_starting_new_task(
+            ENV, lease_s=15.0, timeout_s=2.0)
+        clock.advance(16)
+        dispatcher.on_expiration_timer()
+        # Renewal after expiry fails (reference task_dispatcher_test.cc:110-145)
+        assert dispatcher.keep_task_alive([gid], 15.0) == [False]
+        # The servant still reports it running -> kill list names it.
+        kill = dispatcher.notify_servant_running_tasks(
+            "10.0.0.1:8335", [gid])
+        assert kill == [gid]
+        # Once the servant stops reporting it, the zombie is released.
+        dispatcher.notify_servant_running_tasks("10.0.0.1:8335", [])
+        assert dispatcher.inspect()["grants_outstanding"] == 0
+
+    def test_zombie_keeps_occupying_capacity(self, dispatcher):
+        clock = dispatcher.clock
+        dispatcher.keep_servant_alive(
+            make_servant("10.0.0.1:8335", capacity=1), 1000)
+        (gid, _), = dispatcher.wait_for_starting_new_task(
+            ENV, lease_s=5.0, timeout_s=2.0)
+        clock.advance(6)
+        dispatcher.on_expiration_timer()
+        # Grant expired -> zombie, but capacity still occupied: no grant.
+        assert dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=0.3) == []
+        # Servant confirms gone -> capacity frees -> next grant succeeds.
+        dispatcher.notify_servant_running_tasks("10.0.0.1:8335", [])
+        grants = dispatcher.wait_for_starting_new_task(ENV, timeout_s=2.0)
+        assert len(grants) == 1
+
+    def test_servant_lease_expiry_orphans_grants(self, dispatcher):
+        clock = dispatcher.clock
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 10)
+        (gid, _), = dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=2.0)
+        clock.advance(11)
+        dispatcher.on_expiration_timer()
+        assert dispatcher.inspect()["servants"] == {}
+        assert dispatcher.inspect()["grants_outstanding"] == 0
+
+    def test_graceful_leave(self, dispatcher):
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 10)
+        dispatcher.keep_servant_alive(make_servant("10.0.0.1:8335"), 0)
+        assert dispatcher.inspect()["servants"] == {}
+
+    def test_blocking_wait_wakes_on_capacity(self, dispatcher):
+        dispatcher.keep_servant_alive(
+            make_servant("10.0.0.1:8335", capacity=1), 1000)
+        (gid, _), = dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=2.0)
+        results = []
+
+        def waiter():
+            results.append(dispatcher.wait_for_starting_new_task(
+                ENV, timeout_s=5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        assert results == []  # still blocked
+        dispatcher.free_task([gid])
+        t.join(timeout=5)
+        assert len(results) == 1 and len(results[0]) == 1
+
+
+class TestPolicyScenarios:
+    def test_prefer_dedicated(self, dispatcher):
+        dispatcher.keep_servant_alive(
+            make_servant("user:1", capacity=10), 1000)
+        dispatcher.keep_servant_alive(
+            make_servant("dedicated:1", capacity=10, dedicated=True), 1000)
+        for _ in range(4):
+            (g, loc), = dispatcher.wait_for_starting_new_task(
+                ENV, timeout_s=2.0)
+            assert loc == "dedicated:1"
+
+    def test_load_balance(self, dispatcher):
+        dispatcher.keep_servant_alive(make_servant("a:1", capacity=4), 1000)
+        dispatcher.keep_servant_alive(make_servant("b:1", capacity=4), 1000)
+        locs = []
+        for _ in range(8):
+            (g, loc), = dispatcher.wait_for_starting_new_task(
+                ENV, timeout_s=2.0)
+            locs.append(loc)
+        assert locs.count("a:1") == 4 and locs.count("b:1") == 4
+
+    def test_memory_starved_servant_excluded(self, dispatcher):
+        info = make_servant("low:1", capacity=8, mem=1 << 30)
+        dispatcher.keep_servant_alive(info, 1000)
+        assert dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=0.3) == []
+
+    def test_not_accepting_reason_excluded(self, dispatcher):
+        info = make_servant("nat:1", capacity=8)
+        info.not_accepting_reason = (
+            api.scheduler.NOT_ACCEPTING_TASK_REASON_BEHIND_NAT)
+        dispatcher.keep_servant_alive(info, 1000)
+        assert dispatcher.wait_for_starting_new_task(
+            ENV, timeout_s=0.3) == []
+
+    def test_version_gate(self, dispatcher):
+        dispatcher.keep_servant_alive(
+            make_servant("old:1", version=1), 1000)
+        assert dispatcher.wait_for_starting_new_task(
+            ENV, min_version=2, timeout_s=0.3) == []
+        dispatcher.keep_servant_alive(
+            make_servant("new:1", version=2), 1000)
+        (g, loc), = dispatcher.wait_for_starting_new_task(
+            ENV, min_version=2, timeout_s=2.0)
+        assert loc == "new:1"
+
+
+class TestTokenRoll:
+    def test_rotation_window(self):
+        clock = VirtualClock(0)
+        roll = ServingDaemonTokenRoll(clock, rotation_s=10)
+        t0 = roll.current()
+        clock.advance(11)
+        t1 = roll.current()
+        assert t1 != t0
+        assert t0 in roll.acceptable()  # old token still acceptable
+        clock.advance(25)
+        assert t0 not in roll.acceptable()  # rolled out of the window
+
+
+class TestSchedulerService:
+    @pytest.fixture
+    def service(self):
+        clock = VirtualClock(100.0)
+        d = TaskDispatcher(GreedyCpuPolicy(), max_servants=16, max_envs=64,
+                           clock=clock, batch_window_s=0.0)
+        svc = SchedulerService(
+            d,
+            user_tokens=TokenVerifier(["user-tok"]),
+            servant_tokens=TokenVerifier(["servant-tok"]),
+            clock=clock,
+        )
+        register_mock_server("sched", svc.spec())
+        yield svc
+        unregister_mock_server("sched")
+        d.stop()
+
+    def _beat(self, ch, location="127.0.0.1:8335", token="servant-tok",
+              capacity=8, running=()):
+        req = api.scheduler.HeartbeatRequest(
+            token=token,
+            next_heartbeat_in_ms=1000,
+            version=1,
+            location=location,
+            num_processors=16,
+            capacity=capacity,
+            total_memory_in_bytes=64 << 30,
+            memory_available_in_bytes=64 << 30,
+        )
+        req.env_descs.add(compiler_digest=ENV)
+        for gid in running:
+            req.running_tasks.add(task_grant_id=gid, servant_task_id=gid,
+                                  task_digest="d")
+        return ch.call("ytpu.SchedulerService", "Heartbeat", req,
+                       api.scheduler.HeartbeatResponse)
+
+    def test_heartbeat_and_grant_flow(self, service):
+        ch = Channel("mock://sched")
+        resp, _ = self._beat(ch)
+        assert len(resp.acceptable_tokens) == 3
+
+        # Delegate calls from a different machine than the servant, else
+        # self-avoidance correctly withholds the grant.
+        ch = Channel("mock://sched@10.77.0.1:5000")
+        wreq = api.scheduler.WaitForStartingTaskRequest(
+            token="user-tok", milliseconds_to_wait=2000, immediate_reqs=1)
+        wreq.env_desc.compiler_digest = ENV
+        wresp, _ = ch.call("ytpu.SchedulerService", "WaitForStartingTask",
+                           wreq, api.scheduler.WaitForStartingTaskResponse)
+        assert len(wresp.grants) == 1
+        gid = wresp.grants[0].task_grant_id
+
+        kresp, _ = ch.call(
+            "ytpu.SchedulerService", "KeepTaskAlive",
+            api.scheduler.KeepTaskAliveRequest(
+                token="user-tok", task_grant_ids=[gid],
+                next_keep_alive_in_ms=15000),
+            api.scheduler.KeepTaskAliveResponse)
+        assert list(kresp.statuses) == [True]
+
+        ch.call("ytpu.SchedulerService", "FreeTask",
+                api.scheduler.FreeTaskRequest(token="user-tok",
+                                              task_grant_ids=[gid]),
+                api.scheduler.FreeTaskResponse)
+
+    def test_bad_tokens_rejected(self, service):
+        ch = Channel("mock://sched")
+        with pytest.raises(RpcError) as ei:
+            self._beat(ch, token="wrong")
+        assert ei.value.status == api.scheduler.SCHEDULER_STATUS_ACCESS_DENIED
+        wreq = api.scheduler.WaitForStartingTaskRequest(token="wrong")
+        wreq.env_desc.compiler_digest = ENV
+        with pytest.raises(RpcError):
+            ch.call("ytpu.SchedulerService", "WaitForStartingTask", wreq,
+                    api.scheduler.WaitForStartingTaskResponse)
+
+    def test_nat_detection_zeroes_capacity(self, service):
+        ch = Channel("mock://sched")
+        # mock transport reports peer 127.0.0.1; servant claims 10.9.9.9.
+        self._beat(ch, location="10.9.9.9:8335")
+        wreq = api.scheduler.WaitForStartingTaskRequest(
+            token="user-tok", milliseconds_to_wait=200)
+        wreq.env_desc.compiler_digest = ENV
+        with pytest.raises(RpcError) as ei:
+            ch.call("ytpu.SchedulerService", "WaitForStartingTask", wreq,
+                    api.scheduler.WaitForStartingTaskResponse)
+        assert ei.value.status == (
+            api.scheduler.SCHEDULER_STATUS_NO_QUOTA_AVAILABLE)
+
+    def test_expired_tasks_reported_in_heartbeat(self, service):
+        ch = Channel("mock://sched")
+        self._beat(ch)
+        wreq = api.scheduler.WaitForStartingTaskRequest(
+            token="user-tok", milliseconds_to_wait=2000,
+            next_keep_alive_in_ms=5000)
+        wreq.env_desc.compiler_digest = ENV
+        dch = Channel("mock://sched@10.77.0.1:5000")
+        wresp, _ = dch.call("ytpu.SchedulerService", "WaitForStartingTask",
+                            wreq, api.scheduler.WaitForStartingTaskResponse)
+        gid = wresp.grants[0].task_grant_id
+        service.dispatcher._clock.advance(6)
+        service.dispatcher.on_expiration_timer()
+        resp, _ = self._beat(ch, running=[gid])
+        assert list(resp.expired_tasks) == [gid]
+
+    def test_get_running_tasks(self, service):
+        ch = Channel("mock://sched")
+        self._beat(ch, running=[77])
+        resp, _ = ch.call("ytpu.SchedulerService", "GetRunningTasks",
+                          api.scheduler.GetRunningTasksRequest(),
+                          api.scheduler.GetRunningTasksResponse)
+        assert len(resp.running_tasks) == 1
+        assert resp.running_tasks[0].task_grant_id == 77

@@ -1,0 +1,213 @@
+"""Batched task->servant assignment kernel.
+
+This is the TPU-native heart of the scheduler: the reference allocates
+grants one blocked RPC at a time under a global mutex — its own comments
+call out that this "doesn't scale well" (yadcc/scheduler/
+task_dispatcher.h:283-288).  Here, waiting requests are micro-batched by
+the host (scheduler/policy.py) and resolved in ONE jitted device call
+that scans the task batch, masking eligibility and picking the best
+servant per task with in-kernel capacity accounting.
+
+Shapes are static — (T tasks, S servant slots, E environment ids) — and
+padded, so XLA compiles exactly once per configuration; servant churn
+mutates array *contents* (slot reuse + alive masking), never shapes.
+
+Policy semantics match yadcc/scheduler/task_dispatcher.cc:316-451
+(eligibility: alive, has environment, version, not the requestor;
+feasibility: running < capacity; preference: dedicated under 50%
+utilization, then minimum utilization; deterministic lowest-slot
+tie-break) and are cross-checked against the greedy CPU oracle in
+tests/test_assignment.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.cost import DEFAULT_COST_MODEL, UTIL_SCALE, DispatchCostModel
+
+NO_PICK = -1  # Emitted for tasks that found no feasible servant.
+
+
+class PoolArrays(NamedTuple):
+    """Struct-of-arrays servant registry snapshot, device-resident.
+
+    One slot per (possibly departed) servant; `alive` masks vacancies so
+    the shapes never change as daemons join and leave.
+    """
+
+    alive: jax.Array       # bool[S]
+    capacity: jax.Array    # int32[S]  max concurrent tasks (0: not accepting)
+    running: jax.Array     # int32[S]  currently granted tasks
+    dedicated: jax.Array   # bool[S]   SERVANT_PRIORITY_DEDICATED
+    version: jax.Array     # int32[S]
+    env_bitmap: jax.Array  # uint32[S, E//32]  environment membership bits
+
+
+class TaskBatch(NamedTuple):
+    """A padded micro-batch of grant requests."""
+
+    env_id: jax.Array       # int32[T] interned environment index
+    min_version: jax.Array  # int32[T]
+    requestor: jax.Array    # int32[T] requestor's servant slot, -1 if none
+    valid: jax.Array        # bool[T]  padding mask
+
+
+def _scores(
+    pool: PoolArrays,
+    running: jax.Array,
+    env_id: jax.Array,
+    min_version: jax.Array,
+    requestor: jax.Array,
+    cm: DispatchCostModel,
+) -> jax.Array:
+    """Per-servant score for one task; lower is better, infeasible is huge."""
+    s = pool.alive.shape[0]
+    slots = jnp.arange(s, dtype=jnp.int32)
+
+    word = jnp.take(pool.env_bitmap, env_id >> 5, axis=1)  # uint32[S]
+    has_env = (word >> jnp.uint32(env_id & 31)) & jnp.uint32(1)
+
+    eligible = (
+        pool.alive
+        & (has_env == 1)
+        & (pool.version >= min_version)
+        & ((slots != requestor) if cm.avoid_self else True)
+    )
+    feasible = eligible & (running < pool.capacity)
+
+    # Fixed-point utilization: exact, backend-independent (see
+    # models/cost.py for why float division is not usable here).
+    util_q = (running * UTIL_SCALE) // jnp.maximum(pool.capacity, 1)
+    preferred = pool.dedicated & (
+        util_q < cm.dedicated_preference_utilization_q
+    )
+    score = jnp.where(preferred, util_q - cm.preference_bonus_q, util_q)
+    return jnp.where(feasible, score, cm.infeasible_score_q)
+
+
+@functools.partial(jax.jit, static_argnames=("cost_model",), donate_argnums=())
+def assign_batch(
+    pool: PoolArrays,
+    batch: TaskBatch,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, jax.Array]:
+    """Assign every task in the batch a servant slot (or NO_PICK).
+
+    Returns (picks int32[T], updated_running int32[S]).  Capacity is
+    consumed sequentially within the batch via lax.scan so the device
+    result is bit-identical to processing the requests one at a time —
+    the contract the greedy CPU oracle defines.
+    """
+    cm = cost_model
+
+    def step(running, task):
+        env_id, min_version, requestor, valid = task
+        score = _scores(pool, running, env_id, min_version, requestor, cm)
+        pick = jnp.argmin(score).astype(jnp.int32)  # lowest slot on ties
+        granted = (score[pick] < cm.infeasible_score_q) & valid
+        running = running.at[pick].add(granted.astype(jnp.int32))
+        return running, jnp.where(granted, pick, NO_PICK)
+
+    running, picks = jax.lax.scan(
+        step,
+        pool.running,
+        (batch.env_id, batch.min_version, batch.requestor, batch.valid),
+    )
+    return picks, running
+
+
+def make_pool(
+    max_servants: int, max_envs: int = 256
+) -> PoolArrays:
+    """Empty pool with static shapes (max_envs must be a multiple of 32)."""
+    assert max_envs % 32 == 0
+    return PoolArrays(
+        alive=jnp.zeros(max_servants, jnp.bool_),
+        capacity=jnp.zeros(max_servants, jnp.int32),
+        running=jnp.zeros(max_servants, jnp.int32),
+        dedicated=jnp.zeros(max_servants, jnp.bool_),
+        version=jnp.zeros(max_servants, jnp.int32),
+        env_bitmap=jnp.zeros((max_servants, max_envs // 32), jnp.uint32),
+    )
+
+
+def make_batch(
+    env_ids, min_versions, requestors, pad_to: int
+) -> TaskBatch:
+    """Host-side helper padding a python request list to the static T."""
+    n = len(env_ids)
+    assert n <= pad_to
+
+    def pad(xs, fill):
+        a = np.full(pad_to, fill, np.int32)
+        a[:n] = np.asarray(xs, np.int32)
+        return jnp.asarray(a)
+
+    valid = np.zeros(pad_to, bool)
+    valid[:n] = True
+    return TaskBatch(
+        env_id=pad(env_ids, 0),
+        min_version=pad(min_versions, 0),
+        requestor=pad(requestors, -1),
+        valid=jnp.asarray(valid),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Greedy CPU oracle — the reference semantics, one request at a time.
+# ---------------------------------------------------------------------------
+
+
+def greedy_assign(
+    pool_np: dict,
+    tasks: list,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> list:
+    """Pure-numpy re-statement of UnsafePickServantFor semantics
+    (yadcc/scheduler/task_dispatcher.cc:362-451), used as the correctness
+    oracle for the device kernel and as the fallback DispatchPolicy.
+
+    pool_np: dict of numpy arrays with PoolArrays' fields.
+    tasks: list of (env_id, min_version, requestor) tuples.
+    Returns a list of servant slots (or NO_PICK), mutating running.
+    """
+    cm = cost_model
+    alive = pool_np["alive"]
+    capacity = pool_np["capacity"]
+    running = pool_np["running"]
+    dedicated = pool_np["dedicated"]
+    version = pool_np["version"]
+    env_bitmap = pool_np["env_bitmap"]
+    s = len(alive)
+
+    picks = []
+    for env_id, min_version, requestor in tasks:
+        word = env_bitmap[:, env_id >> 5]
+        has_env = (word >> np.uint32(env_id & 31)) & 1
+        best, best_score = NO_PICK, cm.infeasible_score_q
+        for i in range(s):
+            if not alive[i] or not has_env[i] or version[i] < min_version:
+                continue
+            if cm.avoid_self and i == requestor:
+                continue
+            if running[i] >= capacity[i]:
+                continue
+            util_q = int(running[i]) * UTIL_SCALE // max(int(capacity[i]), 1)
+            score = (
+                util_q - cm.preference_bonus_q
+                if dedicated[i]
+                and util_q < cm.dedicated_preference_utilization_q
+                else util_q
+            )
+            if score < best_score:  # strict: lowest slot wins ties
+                best, best_score = i, score
+        picks.append(best)
+        if best != NO_PICK:
+            running[best] += 1
+    return picks

@@ -169,8 +169,14 @@ class Channel:
 
 
 class _MockChannel(Channel):
+    """``mock://name`` — optionally ``mock://name@ip:port`` to control the
+    peer address the server-side context observes (exercises NAT
+    detection and self-avoidance in tests)."""
+
     def __init__(self, uri: str):
-        self._name = uri[len("mock://") :]
+        rest = uri[len("mock://") :]
+        self._name, _, peer = rest.partition("@")
+        self._peer = peer or "127.0.0.1:0"
 
     def call(self, service, method_name, request, response_cls,
              attachment=b"", timeout=None):
@@ -181,7 +187,7 @@ class _MockChannel(Channel):
                            f"no mock server for {self._name}/{service}")
         frame = encode_frame(0, request.SerializeToString(), attachment)
         reply = dispatch_frame(services[service], method_name, frame,
-                               peer="127.0.0.1:0")
+                               peer=self._peer)
         status, meta, att = decode_frame(reply)
         if status != 0:
             raise RpcError(status, meta.decode(errors="replace"))

@@ -1,0 +1,91 @@
+"""Scheduler server main.
+
+Parity with reference yadcc/scheduler/entry.cc (flare server on :8336)
+plus the inspect endpoint.  Run:
+
+    python -m yadcc_tpu.scheduler.entry --port 8336 \
+        --dispatch-policy jax_batched
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+
+from ..common.token_verifier import make_token_verifier_from_flag
+from ..rpc import GrpcServer
+from ..utils import exposed_vars
+from ..utils.inspect_server import InspectServer
+from ..utils.logging import get_logger
+from .policy import make_policy
+from .service import SchedulerService
+from .task_dispatcher import TaskDispatcher
+
+logger = get_logger("scheduler.entry")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("yadcc-tpu-scheduler")
+    p.add_argument("--port", type=int, default=8336)
+    p.add_argument("--inspect-port", type=int, default=9336)
+    p.add_argument("--inspect-credential", default="")
+    p.add_argument("--dispatch-policy", default="greedy_cpu",
+                   choices=["greedy_cpu", "jax_batched"])
+    p.add_argument("--max-servants", type=int, default=8192)
+    p.add_argument("--min-daemon-version", type=int, default=0)
+    p.add_argument("--acceptable-user-tokens", default="")
+    p.add_argument("--acceptable-servant-tokens", default="")
+    p.add_argument("--servant-min-memory-for-new-task",
+                   default="10G")
+    return p
+
+
+def scheduler_start(args) -> None:
+    from ..common.parse_size import parse_size
+
+    policy = make_policy(args.dispatch_policy, args.max_servants)
+    dispatcher = TaskDispatcher(
+        policy,
+        max_servants=args.max_servants,
+        min_memory_for_new_task=parse_size(
+            args.servant_min_memory_for_new_task),
+    )
+    service = SchedulerService(
+        dispatcher,
+        user_tokens=make_token_verifier_from_flag(
+            args.acceptable_user_tokens),
+        servant_tokens=make_token_verifier_from_flag(
+            args.acceptable_servant_tokens),
+        min_daemon_version=args.min_daemon_version,
+    )
+    exposed_vars.expose("yadcc/task_dispatcher", dispatcher.inspect)
+
+    server = GrpcServer(f"0.0.0.0:{args.port}")
+    server.add_service(service.spec())
+    server.start()
+    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect.start()
+    logger.info("scheduler serving on :%d (policy=%s), inspect on :%d",
+                args.port, policy.name, inspect.port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    # 1s expiration sweep (reference task_dispatcher.cc:498-536).
+    while not stop.is_set():
+        time.sleep(1.0)
+        dispatcher.on_expiration_timer()
+    logger.info("shutting down")
+    server.stop()
+    inspect.stop()
+    dispatcher.stop()
+
+
+def main() -> None:
+    scheduler_start(build_arg_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,523 @@
+"""TaskDispatcher: the scheduler's core state machine.
+
+Capability parity with reference yadcc/scheduler/task_dispatcher.{h,cc}
+(servant registry + grant registry, blocking grant allocation, lease
+renewal, zombie/orphan GC) with one deliberate architectural change: the
+reference resolves each WaitForStartingTask request individually inside a
+global mutex — a documented scaling bottleneck (task_dispatcher.h:283-288)
+— whereas here requests park in a queue and a single dispatch loop
+resolves the whole backlog per cycle through the DispatchPolicy SPI
+(greedy CPU, or the batched JAX kernel on TPU).  Bookkeeping (leases,
+zombies, wakeups) stays host-side: it's I/O-shaped state, not math.
+
+Lifecycle parity notes:
+* Servants live by heartbeat lease (reference: 1s beat / 10s lease); an
+  expired servant is dropped and its grants orphan-swept
+  (task_dispatcher.cc:498-536, :478-496).
+* Grants are leases too (15s, renewed in batches).  An expired grant
+  turns *zombie*: it stops being renewable but keeps occupying servant
+  capacity until the servant's heartbeat confirms the task is gone —
+  dropping it instantly would over-schedule the servant
+  (task_dispatcher.h:207-214).
+* The servant's heartbeat carries its actually-running task list; the
+  scheduler answers with the grant ids it has expired so the servant can
+  kill them (task_dispatcher.cc:222-277).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..utils.clock import REAL_CLOCK, Clock
+from ..utils.logging import get_logger
+from ..ops.assignment import NO_PICK
+from .policy import AssignRequest, DispatchPolicy, EnvRegistry, PoolSnapshot
+
+logger = get_logger("scheduler.dispatcher")
+
+# Grants whose zombie state outlives this many seconds are dropped even
+# without servant confirmation (e.g. the servant died as well and its
+# registry entry vanished before reporting).
+_ZOMBIE_TIMEOUT_S = 60.0
+
+
+@dataclass
+class ServantInfo:
+    """Facts reported via heartbeat (api.scheduler.HeartbeatRequest)."""
+
+    location: str
+    version: int = 1
+    num_processors: int = 0
+    current_load: int = 0
+    dedicated: bool = False
+    not_accepting_reason: int = 0
+    capacity: int = 0
+    total_memory: int = 0
+    memory_available: int = 0
+    env_digests: Tuple[str, ...] = ()
+
+
+@dataclass
+class _Servant:
+    slot: int
+    info: ServantInfo
+    expires_at: float = 0.0
+    running_grants: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class _Grant:
+    grant_id: int
+    slot: int
+    servant_location: str
+    env_digest: str
+    expires_at: float
+    zombie_since: Optional[float] = None
+    requestor: str = ""
+
+
+@dataclass
+class _Pending:
+    env_id: int
+    env_digest: str
+    min_version: int
+    requestor_slot: int
+    requestor: str
+    lease_s: float
+    immediate_left: int
+    prefetch_left: int
+    deadline: float
+    first_cycle_done: bool = False
+    abandoned: bool = False  # caller gave up; grants must not be issued
+    grants: List[_Grant] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+class TaskDispatcher:
+    def __init__(
+        self,
+        policy: DispatchPolicy,
+        *,
+        max_servants: int = 8192,
+        max_envs: int = 256,
+        min_memory_for_new_task: int = 10 << 30,
+        clock: Clock = REAL_CLOCK,
+        batch_window_s: float = 0.002,
+        start_dispatch_thread: bool = True,
+    ):
+        self._policy = policy
+        self._clock = clock
+        self._min_memory = min_memory_for_new_task
+        self._batch_window = batch_window_s
+        self.max_servants = max_servants
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._envs = EnvRegistry(max_envs)
+        self._env_words = max_envs // 32
+
+        self._slots: List[Optional[_Servant]] = [None] * max_servants
+        self._free_slots = list(range(max_servants - 1, -1, -1))
+        self._by_location: Dict[str, int] = {}
+
+        self._grants: Dict[int, _Grant] = {}
+        self._next_grant_id = 1
+
+        self._pending: List[_Pending] = []
+        self._stopping = False
+        self._stats = {"granted": 0, "expired_grants": 0, "zombies_killed": 0}
+
+        self._thread: Optional[threading.Thread] = None
+        if start_dispatch_thread:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="dispatch", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # Servant registry (heartbeat side).
+    # ------------------------------------------------------------------
+
+    def keep_servant_alive(self, info: ServantInfo,
+                           expires_in_s: float) -> bool:
+        """Upsert a servant; expires_in_s <= 0 is a graceful leave
+        (reference scheduler_service_impl.cc:164-170).  Returns False
+        when the registry is full and the servant was NOT registered —
+        the caller must surface that as a heartbeat failure."""
+        with self._lock:
+            slot = self._by_location.get(info.location)
+            if expires_in_s <= 0:
+                if slot is not None:
+                    self._drop_servant_locked(slot)
+                    self._work.notify_all()
+                return True
+            if slot is None:
+                if not self._free_slots:
+                    logger.warning("servant registry full; rejecting %s",
+                                   info.location)
+                    return False
+                slot = self._free_slots.pop()
+                self._slots[slot] = _Servant(slot=slot, info=info)
+                self._by_location[info.location] = slot
+            servant = self._slots[slot]
+            servant.info = info
+            servant.expires_at = self._clock.now() + expires_in_s
+            for digest in info.env_digests:
+                self._envs.intern(digest)
+            self._work.notify_all()
+            return True
+
+    def notify_servant_running_tasks(
+        self, location: str, reported_grant_ids: Sequence[int]
+    ) -> List[int]:
+        """Reconcile the servant's actually-running set with ours.
+
+        Returns grant ids the servant should kill: ids it reports that we
+        have expired (zombies) or never knew.  Zombies *not* reported any
+        more are finally released.
+        """
+        kill: List[int] = []
+        with self._lock:
+            slot = self._by_location.get(location)
+            if slot is None:
+                return list(reported_grant_ids)
+            servant = self._slots[slot]
+            reported = set(reported_grant_ids)
+            for gid in reported:
+                g = self._grants.get(gid)
+                if g is None or g.zombie_since is not None or g.slot != slot:
+                    kill.append(gid)
+            # A zombie this servant no longer reports is truly gone.
+            for gid in list(servant.running_grants):
+                g = self._grants.get(gid)
+                if g is not None and g.zombie_since is not None and (
+                    gid not in reported
+                ):
+                    self._release_grant_locked(g)
+                    self._stats["zombies_killed"] += 1
+            if kill:
+                self._work.notify_all()
+        return kill
+
+    # ------------------------------------------------------------------
+    # Grant allocation (delegate side).
+    # ------------------------------------------------------------------
+
+    def wait_for_starting_new_task(
+        self,
+        env_digest: str,
+        *,
+        min_version: int = 0,
+        requestor: str = "",
+        immediate: int = 1,
+        prefetch: int = 0,
+        lease_s: float = 15.0,
+        timeout_s: float = 5.0,
+    ) -> List[Tuple[int, str]]:
+        """Blocking allocation; returns [(grant_id, servant_location)].
+
+        May return fewer grants than requested (reference semantics).
+        Returns [] when no eligible servant frees up within timeout_s.
+        """
+        env_id = self._envs.intern(env_digest)
+        if env_id is None:
+            return []
+        with self._lock:
+            req = _Pending(
+                env_id=env_id,
+                env_digest=env_digest,
+                min_version=min_version,
+                requestor_slot=self._requestor_slot_locked(requestor),
+                requestor=requestor,
+                lease_s=lease_s,
+                immediate_left=max(0, immediate),
+                prefetch_left=max(0, prefetch),
+                deadline=self._clock.now() + timeout_s,
+            )
+            if req.immediate_left + req.prefetch_left == 0:
+                return []
+            self._pending.append(req)
+            self._work.notify_all()
+        req.done.wait(timeout=timeout_s + 1.0)
+        with self._lock:
+            # From here on a racing apply phase must not issue us grants
+            # we'd never see (they would leak the servant's capacity).
+            req.abandoned = True
+            if req in self._pending:
+                self._pending.remove(req)
+            return [(g.grant_id, g.servant_location) for g in req.grants]
+
+    def keep_task_alive(
+        self, grant_ids: Sequence[int], next_keep_alive_s: float
+    ) -> List[bool]:
+        now = self._clock.now()
+        out = []
+        with self._lock:
+            for gid in grant_ids:
+                g = self._grants.get(gid)
+                if g is None or g.zombie_since is not None:
+                    out.append(False)
+                    continue
+                g.expires_at = now + next_keep_alive_s
+                out.append(True)
+        return out
+
+    def free_task(self, grant_ids: Sequence[int]) -> None:
+        with self._lock:
+            for gid in grant_ids:
+                g = self._grants.get(gid)
+                if g is not None:
+                    self._release_grant_locked(g)
+            self._work.notify_all()
+
+    def get_running_tasks(self) -> List[_Grant]:
+        with self._lock:
+            return [g for g in self._grants.values()
+                    if g.zombie_since is None]
+
+    # ------------------------------------------------------------------
+    # Timers.
+    # ------------------------------------------------------------------
+
+    def on_expiration_timer(self) -> None:
+        """1s-cadence sweep: expire servants, zombify expired grants,
+        orphan-sweep grants on dead servants."""
+        now = self._clock.now()
+        with self._lock:
+            for slot, servant in enumerate(self._slots):
+                if servant is not None and servant.expires_at <= now:
+                    self._drop_servant_locked(slot)
+            for g in list(self._grants.values()):
+                if g.zombie_since is None and g.expires_at <= now:
+                    g.zombie_since = now
+                    self._stats["expired_grants"] += 1
+                elif g.zombie_since is not None and (
+                    now - g.zombie_since > _ZOMBIE_TIMEOUT_S
+                ):
+                    self._release_grant_locked(g)
+            self._work.notify_all()
+
+    # ------------------------------------------------------------------
+    # The dispatch cycle.
+    # ------------------------------------------------------------------
+
+    def run_dispatch_cycle_for_testing(self) -> int:
+        return self._run_cycle()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._stopping:
+                    self._work.wait(timeout=0.1)
+                if self._stopping:
+                    return
+            if self._batch_window > 0:
+                # Let a burst of requests accumulate into one kernel call.
+                REAL_CLOCK.sleep(self._batch_window)
+            self._run_cycle()
+            with self._lock:
+                # Park until something can change the outcome — every
+                # state change (new request, free_task, heartbeat,
+                # expiration sweep) notifies _work; the timeout only
+                # bounds deadline handling for parked waiters.
+                if self._pending and not self._stopping:
+                    self._work.wait(timeout=0.25)
+
+    def _run_cycle(self) -> int:
+        """One policy pass over the backlog; returns grants issued."""
+        with self._lock:
+            now = self._clock.now()
+            self._expire_pending_locked(now)
+            if not self._pending:
+                return 0
+            snap = self._snapshot_locked()
+            work: List[Tuple[_Pending, bool]] = []  # (request, is_prefetch)
+            for req in self._pending:
+                for _ in range(req.immediate_left):
+                    work.append((req, False))
+                if not req.first_cycle_done:
+                    for _ in range(req.prefetch_left):
+                        work.append((req, True))
+            reqs = [
+                AssignRequest(r.env_id, r.min_version, r.requestor_slot)
+                for r, _ in work
+            ]
+        if not reqs:
+            return 0
+
+        picks = self._policy.assign(snap, reqs)
+
+        issued = 0
+        with self._lock:
+            now = self._clock.now()
+            for (req, is_prefetch), pick in zip(work, picks):
+                if pick == NO_PICK or req.abandoned:
+                    continue
+                servant = self._slots[pick] if pick < len(self._slots) else None
+                if servant is None:
+                    continue  # died between snapshot and apply
+                # Re-validate capacity at apply time; the snapshot may be
+                # stale (capacity shrank, other grants applied).
+                if len(servant.running_grants) >= self._effective_capacity_locked(
+                    servant
+                ):
+                    continue
+                g = _Grant(
+                    grant_id=self._next_grant_id,
+                    slot=pick,
+                    servant_location=servant.info.location,
+                    env_digest=req.env_digest,
+                    expires_at=now + req.lease_s,
+                    requestor=req.requestor,
+                )
+                self._next_grant_id += 1
+                self._grants[g.grant_id] = g
+                servant.running_grants.add(g.grant_id)
+                req.grants.append(g)
+                if is_prefetch:
+                    req.prefetch_left -= 1
+                else:
+                    req.immediate_left -= 1
+                issued += 1
+                self._stats["granted"] += 1
+            # Prefetch never waits — but only for requests that actually
+            # participated in this cycle; one that arrived mid-assign
+            # keeps its prefetch for the next cycle.
+            participated = {id(r) for r, _ in work}
+            for req in self._pending:
+                if id(req) in participated:
+                    req.first_cycle_done = True
+                    req.prefetch_left = 0
+            self._finish_satisfied_locked(self._clock.now())
+        return issued
+
+    # ------------------------------------------------------------------
+    # Locked helpers.
+    # ------------------------------------------------------------------
+
+    def _requestor_slot_locked(self, requestor: str) -> int:
+        """Map a delegate's observed peer address to its servant slot, if
+        the same machine also serves (self-avoidance: reference
+        task_dispatcher.cc:370-379).  Delegates call from an ephemeral
+        port, so match on the IP alone."""
+        if not requestor:
+            return -1
+        slot = self._by_location.get(requestor)
+        if slot is not None:
+            return slot
+        ip = requestor.rsplit(":", 1)[0]
+        for location, slot in self._by_location.items():
+            if location.rsplit(":", 1)[0] == ip:
+                return slot
+        return -1
+
+    def _expire_pending_locked(self, now: float) -> None:
+        still = []
+        for req in self._pending:
+            if req.immediate_left <= 0 or now >= req.deadline:
+                req.done.set()
+            else:
+                still.append(req)
+        self._pending[:] = still
+
+    def _finish_satisfied_locked(self, now: float) -> None:
+        self._expire_pending_locked(now)
+
+    def _effective_capacity_locked(self, servant: _Servant) -> int:
+        """Reference GetCapacityAvailable (task_dispatcher.cc:283-313):
+        zero if not accepting or memory-starved, else reported capacity
+        minus load not attributable to tasks we placed there."""
+        info = servant.info
+        if info.not_accepting_reason != 0:
+            return 0
+        if info.memory_available < self._min_memory:
+            return 0
+        foreign_load = max(
+            0, info.current_load - len(servant.running_grants)
+        )
+        return max(0, min(info.capacity, info.num_processors - foreign_load))
+
+    def _snapshot_locked(self) -> PoolSnapshot:
+        s = self.max_servants
+        alive = np.zeros(s, bool)
+        capacity = np.zeros(s, np.int32)
+        running = np.zeros(s, np.int32)
+        dedicated = np.zeros(s, bool)
+        version = np.zeros(s, np.int32)
+        env_bitmap = np.zeros((s, self._env_words), np.uint32)
+        for slot, servant in enumerate(self._slots):
+            if servant is None:
+                continue
+            alive[slot] = True
+            capacity[slot] = self._effective_capacity_locked(servant)
+            running[slot] = len(servant.running_grants)
+            dedicated[slot] = servant.info.dedicated
+            version[slot] = servant.info.version
+            for digest in servant.info.env_digests:
+                env_id = self._envs.lookup(digest)
+                if env_id is not None:
+                    env_bitmap[slot, env_id >> 5] |= np.uint32(
+                        1 << (env_id & 31)
+                    )
+        return PoolSnapshot(alive, capacity, running, dedicated, version,
+                            env_bitmap)
+
+    def _drop_servant_locked(self, slot: int) -> None:
+        servant = self._slots[slot]
+        if servant is None:
+            return
+        # Orphan sweep: grants on a dead servant are unrecoverable.
+        for gid in list(servant.running_grants):
+            g = self._grants.pop(gid, None)
+            if g is not None:
+                servant.running_grants.discard(gid)
+        del self._by_location[servant.info.location]
+        self._slots[slot] = None
+        self._free_slots.append(slot)
+
+    def _release_grant_locked(self, g: _Grant) -> None:
+        self._grants.pop(g.grant_id, None)
+        servant = self._slots[g.slot] if g.slot < len(self._slots) else None
+        if servant is not None and servant.info.location == g.servant_location:
+            servant.running_grants.discard(g.grant_id)
+
+    # ------------------------------------------------------------------
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopping = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def inspect(self) -> dict:
+        with self._lock:
+            servants = {}
+            for servant in self._slots:
+                if servant is None:
+                    continue
+                servants[servant.info.location] = {
+                    "slot": servant.slot,
+                    "capacity": servant.info.capacity,
+                    "effective_capacity":
+                        self._effective_capacity_locked(servant),
+                    "running": len(servant.running_grants),
+                    "dedicated": servant.info.dedicated,
+                    "version": servant.info.version,
+                    "envs": list(servant.info.env_digests),
+                    "expires_at": servant.expires_at,
+                }
+            return {
+                "policy": self._policy.name,
+                "servants": servants,
+                "grants_outstanding": len(self._grants),
+                "zombies": sum(1 for g in self._grants.values()
+                               if g.zombie_since is not None),
+                "pending_requests": len(self._pending),
+                "stats": dict(self._stats),
+                "envs_interned": len(self._envs),
+            }
