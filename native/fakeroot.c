@@ -1,0 +1,82 @@
+/* LD_PRELOAD shim making preprocessed output machine-independent.
+ *
+ * Concept parity with the reference's libfakeroot
+ * (yadcc/client/cxx/libfakeroot/fakeroot.c): GCC's preprocessor emits
+ * linemarkers ("# <line> \"<file>\" <flags>") through fprintf using the
+ * format string "# %u \"%s\"%s".  Files living under the compiler's own
+ * installation directory (libstdc++ headers etc.) therefore embed the
+ * install path, which differs across machines even for bit-identical
+ * compilers — gratuitously splitting the distributed cache.  This shim
+ * interposes fprintf: when the format matches a linemarker and the path
+ * begins with the directory named by $YTPU_INTERNAL_COMPILER_PATH, the
+ * prefix is replaced with the fixed token "/ytpu/compiler", making the
+ * preprocessed bytes (and hence the cache key) identical everywhere.
+ *
+ * Everything else passes straight through to the real fprintf.
+ *
+ * Build: make -C native   (produces libytpufakeroot.so)
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define FAKE_PREFIX "/ytpu/compiler"
+
+static int (*real_vfprintf)(FILE *, const char *, va_list) = NULL;
+static const char *g_compiler_root = NULL;
+static size_t g_compiler_root_len = 0;
+static int g_initialized = 0;
+
+static void init_once(void) {
+  if (g_initialized) return;
+  g_initialized = 1;
+  real_vfprintf = (int (*)(FILE *, const char *, va_list))dlsym(
+      RTLD_NEXT, "vfprintf");
+  g_compiler_root = getenv("YTPU_INTERNAL_COMPILER_PATH");
+  if (g_compiler_root != NULL && g_compiler_root[0] != '\0') {
+    g_compiler_root_len = strlen(g_compiler_root);
+  } else {
+    g_compiler_root = NULL;
+  }
+}
+
+static int emit(FILE *stream, const char *fmt, ...) {
+  va_list ap;
+  int rc;
+  va_start(ap, fmt);
+  rc = real_vfprintf != NULL ? real_vfprintf(stream, fmt, ap) : -1;
+  va_end(ap);
+  return rc;
+}
+
+/* GCC's linemarker format string, byte-for-byte (libcpp). */
+static int is_linemarker_format(const char *fmt) {
+  return strcmp(fmt, "# %u \"%s\"%s") == 0;
+}
+
+int fprintf(FILE *stream, const char *fmt, ...) {
+  va_list ap;
+  int rc;
+
+  init_once();
+  va_start(ap, fmt);
+  if (g_compiler_root != NULL && is_linemarker_format(fmt)) {
+    unsigned line = va_arg(ap, unsigned);
+    const char *path = va_arg(ap, const char *);
+    const char *flags = va_arg(ap, const char *);
+    va_end(ap);
+    if (path != NULL &&
+        strncmp(path, g_compiler_root, g_compiler_root_len) == 0) {
+      return emit(stream, "# %u \"%s%s\"%s", line, FAKE_PREFIX,
+                  path + g_compiler_root_len, flags);
+    }
+    return emit(stream, "# %u \"%s\"%s", line, path, flags);
+  }
+  rc = real_vfprintf != NULL ? real_vfprintf(stream, fmt, ap) : -1;
+  va_end(ap);
+  return rc;
+}

@@ -1,0 +1,306 @@
+"""Cache server tests: ARC, engines, Bloom generator, service."""
+
+import numpy as np
+import pytest
+
+from yadcc_tpu import api
+from yadcc_tpu.cache.bloom_filter_generator import (
+    BloomFilterGenerator,
+    DeviceBloomReplica,
+)
+from yadcc_tpu.cache.cache_engine import NullCacheEngine, make_engine
+from yadcc_tpu.cache.disk_engine import DiskCacheEngine
+from yadcc_tpu.cache.in_memory_cache import InMemoryCache
+from yadcc_tpu.cache.object_store_engine import (
+    FsObjectStoreBackend,
+    ObjectStoreEngine,
+)
+from yadcc_tpu.cache.service import CacheService
+from yadcc_tpu.common import compress
+from yadcc_tpu.common.bloom import SaltedBloomFilter
+from yadcc_tpu.common.disk_cache import ShardSpec
+from yadcc_tpu.common.token_verifier import TokenVerifier
+from yadcc_tpu.rpc import Channel, RpcError, register_mock_server, \
+    unregister_mock_server
+from yadcc_tpu.utils.clock import VirtualClock
+
+
+class TestArc:
+    def test_basic(self):
+        c = InMemoryCache(1000)
+        c.put("a", b"x" * 100)
+        assert c.try_get("a") == b"x" * 100
+        assert c.try_get("b") is None
+        assert c.total_bytes() == 100
+
+    def test_eviction_bounded(self):
+        c = InMemoryCache(1000)
+        for i in range(50):
+            c.put(f"k{i}", b"y" * 100)
+        assert c.total_bytes() <= 1000
+
+    def test_frequent_entries_survive_scan(self):
+        # ARC's reason to exist: a one-shot scan must not flush the
+        # frequently-hit working set the way plain LRU does.
+        c = InMemoryCache(1000)
+        for i in range(5):
+            c.put(f"hot{i}", b"h" * 100)
+        for _ in range(3):
+            for i in range(5):
+                assert c.try_get(f"hot{i}") is not None
+        for i in range(100):  # scan of cold one-shot entries
+            c.put(f"cold{i}", b"c" * 100)
+        survivors = sum(
+            c.try_get(f"hot{i}") is not None for i in range(5))
+        assert survivors >= 3
+
+    def test_update_in_place(self):
+        c = InMemoryCache(1000)
+        c.put("k", b"a" * 100)
+        c.put("k", b"b" * 300)
+        assert c.try_get("k") == b"b" * 300
+        assert c.total_bytes() == 300
+
+    def test_oversized_rejected(self):
+        c = InMemoryCache(100)
+        c.put("big", b"z" * 1000)
+        assert c.try_get("big") is None
+
+    def test_ghost_hit_readmits_to_t2(self):
+        c = InMemoryCache(300)
+        c.put("a", b"1" * 100)
+        c.put("b", b"2" * 100)
+        c.put("c", b"3" * 100)
+        c.put("d", b"4" * 100)  # evicts something into a ghost list
+        # Re-put a ghost key: must be admitted to T2 (frequency).
+        c.put("a", b"1" * 100)
+        stats = c.stats()
+        assert stats["t1_bytes"] + stats["t2_bytes"] <= 300
+
+    def test_remove(self):
+        c = InMemoryCache(1000)
+        c.put("k", b"v")
+        assert c.remove("k")
+        assert c.try_get("k") is None
+        assert not c.remove("k")
+
+
+class TestEngines:
+    def test_null(self):
+        e = NullCacheEngine()
+        e.put("k", b"v")
+        assert e.try_get("k") is None
+        assert e.keys() == []
+
+    def test_disk_roundtrip_and_keys(self, tmp_path):
+        e = DiskCacheEngine([ShardSpec(str(tmp_path / "s"), 1 << 20)])
+        e.put("yadcc-entry-1", b"obj1")
+        e.put("yadcc-entry-2", b"obj2")
+        assert e.try_get("yadcc-entry-1") == b"obj1"
+        assert sorted(e.keys()) == ["yadcc-entry-1", "yadcc-entry-2"]
+        # Manifest survives restart (drives Bloom rebuild).
+        e2 = DiskCacheEngine([ShardSpec(str(tmp_path / "s"), 1 << 20)])
+        assert sorted(e2.keys()) == ["yadcc-entry-1", "yadcc-entry-2"]
+        assert e2.try_get("yadcc-entry-2") == b"obj2"
+
+    def test_disk_remove_updates_keys(self, tmp_path):
+        e = DiskCacheEngine([ShardSpec(str(tmp_path / "s"), 1 << 20)])
+        e.put("k", b"v")
+        e.remove("k")
+        assert e.keys() == []
+
+    def test_objstore_roundtrip_and_keys(self, tmp_path):
+        e = ObjectStoreEngine(FsObjectStoreBackend(str(tmp_path / "o")),
+                              capacity_bytes=1 << 20)
+        e.put("key-a", b"A" * 10)
+        e.put("key-b", b"B" * 10)
+        assert e.try_get("key-a") == b"A" * 10
+        assert sorted(e.keys()) == ["key-a", "key-b"]
+        # Restart: keys recovered from object headers.
+        e2 = ObjectStoreEngine(FsObjectStoreBackend(str(tmp_path / "o")),
+                               capacity_bytes=1 << 20)
+        assert sorted(e2.keys()) == ["key-a", "key-b"]
+
+    def test_objstore_purge(self, tmp_path):
+        e = ObjectStoreEngine(FsObjectStoreBackend(str(tmp_path / "o")),
+                              capacity_bytes=500)
+        for i in range(20):
+            e.put(f"k{i}", b"x" * 100)
+        assert e.stats()["total_bytes"] <= 500
+
+    def test_registry(self, tmp_path):
+        e = make_engine("null")
+        assert e.name == "null"
+        with pytest.raises(ValueError):
+            make_engine("bogus")
+
+
+class TestBloomGenerator:
+    def test_incremental_keys_window(self):
+        clock = VirtualClock(0)
+        g = BloomFilterGenerator(num_bits=100003, num_hashes=5, clock=clock,
+                                 salt=1)
+        g.add("k1")
+        clock.advance(100)
+        g.add("k2")
+        assert set(g.get_newly_populated_keys(50)) == {"k2"}
+        assert set(g.get_newly_populated_keys(200)) == {"k1", "k2"}
+        clock.advance(3700)
+        assert g.get_newly_populated_keys(3600) == []
+
+    def test_rebuild_keeps_compensation_window(self):
+        clock = VirtualClock(0)
+        g = BloomFilterGenerator(num_bits=100003, num_hashes=5, clock=clock,
+                                 salt=1)
+        g.add("during-rebuild")
+        g.rebuild(["from-engine"])
+        assert g.may_contain("from-engine")
+        assert g.may_contain("during-rebuild")  # not lost by the swap
+
+    def test_client_replica_agrees(self):
+        clock = VirtualClock(0)
+        g = BloomFilterGenerator(clock=clock, salt=7)
+        for i in range(50):
+            g.add(f"entry-{i}")
+        replica = SaltedBloomFilter.from_bytes(
+            g.filter_bytes(), g.num_hashes, g.salt)
+        assert all(replica.may_contain(f"entry-{i}") for i in range(50))
+        assert not replica.may_contain("never-added-xyz")
+
+    def test_device_replica_batch(self):
+        clock = VirtualClock(0)
+        g = BloomFilterGenerator(clock=clock, salt=9)
+        keys = [f"obj-{i}" for i in range(200)]
+        for k in keys[:100]:
+            g.add(k)
+        replica = DeviceBloomReplica(g.filter_bytes(), g.num_hashes, g.salt)
+        got = replica.may_contain_batch(keys)
+        assert got[:100].all()
+        assert not got[100:].any()
+
+
+class TestCacheService:
+    @pytest.fixture
+    def service(self, tmp_path):
+        clock = VirtualClock(1000.0)
+        svc = CacheService(
+            InMemoryCache(1 << 20),
+            DiskCacheEngine([ShardSpec(str(tmp_path / "l2"), 1 << 20)]),
+            user_tokens=TokenVerifier(["user"]),
+            servant_tokens=TokenVerifier(["servant"]),
+            clock=clock,
+        )
+        svc.clock = clock
+        register_mock_server("cache", svc.spec())
+        yield svc
+        unregister_mock_server("cache")
+
+    def test_put_get_roundtrip(self, service):
+        ch = Channel("mock://cache")
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="K"),
+                api.cache.PutEntryResponse, attachment=b"OBJ")
+        resp, att = ch.call("ytpu.CacheService", "TryGetEntry",
+                            api.cache.TryGetEntryRequest(token="user", key="K"),
+                            api.cache.TryGetEntryResponse)
+        assert att == b"OBJ"
+
+    def test_miss_is_not_found(self, service):
+        ch = Channel("mock://cache")
+        with pytest.raises(RpcError) as ei:
+            ch.call("ytpu.CacheService", "TryGetEntry",
+                    api.cache.TryGetEntryRequest(token="user", key="nope"),
+                    api.cache.TryGetEntryResponse)
+        assert ei.value.status == api.cache.CACHE_STATUS_NOT_FOUND
+
+    def test_user_token_cannot_fill(self, service):
+        ch = Channel("mock://cache")
+        with pytest.raises(RpcError) as ei:
+            ch.call("ytpu.CacheService", "PutEntry",
+                    api.cache.PutEntryRequest(token="user", key="K"),
+                    api.cache.PutEntryResponse, attachment=b"EVIL")
+        assert ei.value.status == api.cache.CACHE_STATUS_ACCESS_DENIED
+
+    def test_l2_promotion(self, service):
+        ch = Channel("mock://cache")
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="K"),
+                api.cache.PutEntryResponse, attachment=b"OBJ")
+        # Drop from L1; next get must hit L2 and promote.
+        service.l1.remove("K")
+        _, att = ch.call("ytpu.CacheService", "TryGetEntry",
+                         api.cache.TryGetEntryRequest(token="user", key="K"),
+                         api.cache.TryGetEntryResponse)
+        assert att == b"OBJ"
+        assert service.l1.try_get("K") == b"OBJ"
+
+    def test_full_then_incremental_bloom_fetch(self, service):
+        ch = Channel("mock://cache")
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="K1"),
+                api.cache.PutEntryResponse, attachment=b"1")
+        # First fetch (ages 0) -> full filter.
+        resp, att = ch.call(
+            "ytpu.CacheService", "FetchBloomFilter",
+            api.cache.FetchBloomFilterRequest(
+                token="user", seconds_since_last_full_fetch=0,
+                seconds_since_last_fetch=0),
+            api.cache.FetchBloomFilterResponse)
+        assert not resp.incremental
+        payload = compress.decompress(att)
+        salt = int.from_bytes(payload[:4], "little")
+        assert salt == service.bloom.salt
+        replica = SaltedBloomFilter.from_bytes(
+            payload[4:], resp.num_hashes, salt)
+        assert replica.may_contain("K1")
+        # Another fill, then an incremental fetch 30s later.
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="K2"),
+                api.cache.PutEntryResponse, attachment=b"2")
+        service.clock.advance(30)
+        resp, _ = ch.call(
+            "ytpu.CacheService", "FetchBloomFilter",
+            api.cache.FetchBloomFilterRequest(
+                token="user", seconds_since_last_full_fetch=30,
+                seconds_since_last_fetch=30),
+            api.cache.FetchBloomFilterResponse)
+        assert resp.incremental
+        assert "K2" in list(resp.newly_populated_keys)
+
+    def test_stale_sync_forced_full(self, service):
+        ch = Channel("mock://cache")
+        resp, att = ch.call(
+            "ytpu.CacheService", "FetchBloomFilter",
+            api.cache.FetchBloomFilterRequest(
+                token="user", seconds_since_last_full_fetch=7200,
+                seconds_since_last_fetch=7200),
+            api.cache.FetchBloomFilterResponse)
+        assert not resp.incremental
+        assert att  # full filter attached
+
+    def test_rebuild_from_l2_after_restart(self, service, tmp_path):
+        ch = Channel("mock://cache")
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="persisted"),
+                api.cache.PutEntryResponse, attachment=b"V")
+        # New service over the same L2 dir: filter must know the key.
+        svc2 = CacheService(
+            InMemoryCache(1 << 20),
+            DiskCacheEngine([ShardSpec(str(tmp_path / "l2"), 1 << 20)]),
+            servant_tokens=TokenVerifier(["servant"]),
+        )
+        assert svc2.bloom.may_contain("persisted")
+
+    def test_oversized_entry_rejected(self, service):
+        import yadcc_tpu.cache.service as csvc
+        ch = Channel("mock://cache")
+        old = csvc._MAX_ENTRY_BYTES
+        csvc._MAX_ENTRY_BYTES = 10
+        try:
+            with pytest.raises(RpcError) as ei:
+                ch.call("ytpu.CacheService", "PutEntry",
+                        api.cache.PutEntryRequest(token="servant", key="big"),
+                        api.cache.PutEntryResponse, attachment=b"x" * 100)
+            assert ei.value.status == api.cache.CACHE_STATUS_INVALID_ARGUMENT
+        finally:
+            csvc._MAX_ENTRY_BYTES = old

@@ -1,0 +1,167 @@
+"""Client-side unit tests (args parsing, distributability, quota,
+submit/wait protocol against a faked daemon transport)."""
+
+import base64
+import json
+
+import pytest
+
+from yadcc_tpu.client import compilation_saas, daemon_call
+from yadcc_tpu.client.compiler_args import CompilerArgs, is_distributable
+from yadcc_tpu.client.daemon_call import DaemonResponse
+from yadcc_tpu.client.task_quota import acquire_task_quota, task_quota
+from yadcc_tpu.common import compress
+from yadcc_tpu.common.multi_chunk import make_multi_chunk, \
+    try_parse_multi_chunk
+
+
+class TestCompilerArgs:
+    def test_parse_basic(self):
+        a = CompilerArgs.parse(
+            ["g++", "-O2", "-c", "foo.cc", "-o", "foo.o", "-I", "inc"])
+        assert a.sources == ["foo.cc"]
+        assert a.try_get("-o") == "foo.o"
+        assert a.has("-c") and not a.has("-S")
+
+    def test_output_inference(self):
+        a = CompilerArgs.parse(["g++", "-c", "dir/foo.cc"])
+        assert a.output_file() == "foo.o"
+
+    def test_rewrite_removes_options_with_values(self):
+        a = CompilerArgs.parse(
+            ["g++", "-O2", "-c", "x.cc", "-o", "x.o", "-I", "inc", "-DA=1"])
+        out = a.rewrite(remove=["-c"], remove_prefix=["-o", "-I"],
+                        keep_sources=False)
+        assert out == ["-O2", "-DA=1"]
+
+    def test_rewrite_keeps_sources_and_adds(self):
+        a = CompilerArgs.parse(["g++", "-c", "x.cc"])
+        out = a.rewrite(remove=["-c"], add=["-E"], keep_sources=True)
+        assert out == ["x.cc", "-E"]
+
+    @pytest.mark.parametrize("argv,ok", [
+        (["g++", "-c", "a.cc"], True),
+        (["g++", "-c", "a.cpp", "-o", "a.o", "-O2"], True),
+        (["g++", "a.cc"], False),                       # link
+        (["g++", "-c", "a.cc", "b.cc"], False),          # multi-file
+        (["g++", "-c", "-"], False),                     # stdin
+        (["g++", "-c", "a.s"], False),                   # assembly
+        (["g++", "-c", "a.cc", "-march=native"], False),
+        (["g++", "-E", "a.cc", "-c"], False),
+        (["g++", "-c", "a.zz"], False),
+    ])
+    def test_distributable(self, argv, ok):
+        got, why = is_distributable(CompilerArgs.parse(argv))
+        assert got == ok, why
+
+
+class FakeDaemon:
+    """daemon_call handler implementing just enough of the local API."""
+
+    def __init__(self):
+        self.digests = {}
+        self.tasks = {}
+        self.next_id = 1
+        self.quota_held = 0
+
+    def __call__(self, method, path, body) -> DaemonResponse:
+        if path == "/local/acquire_quota":
+            self.quota_held += 1
+            return DaemonResponse(200, b"{}")
+        if path == "/local/release_quota":
+            self.quota_held -= 1
+            return DaemonResponse(200, b"{}")
+        if path == "/local/set_file_digest":
+            msg = json.loads(body)
+            self.digests[msg["file_desc"]["path"]] = msg["digest"]
+            return DaemonResponse(200, b"{}")
+        if path == "/local/submit_cxx_task":
+            chunks = try_parse_multi_chunk(body)
+            msg = json.loads(chunks[0])
+            if msg["compiler"]["path"] not in self.digests:
+                return DaemonResponse(400, b"")
+            tid = self.next_id
+            self.next_id += 1
+            self.tasks[tid] = chunks[1]
+            return DaemonResponse(200, json.dumps(
+                {"task_id": str(tid)}).encode())
+        if path == "/local/wait_for_cxx_task":
+            msg = json.loads(body)
+            tid = int(msg["task_id"])
+            if tid not in self.tasks:
+                return DaemonResponse(404, b"")
+            obj = b"OBJECT" + compress.decompress(self.tasks[tid])[:8]
+            meta = {
+                "exit_code": 0, "output": "", "error": "",
+                "file_extensions": [".o"],
+                "patches": [{"file_key": ".o", "locations": [
+                    {"position": 0, "total_size": 6,
+                     "suffix_to_keep": base64.b64encode(b"OB").decode()},
+                ]}],
+            }
+            return DaemonResponse(200, make_multi_chunk(
+                [json.dumps(meta).encode(), compress.compress(obj)]))
+        return DaemonResponse(404, b"")
+
+
+class TestClientDaemonProtocol:
+    @pytest.fixture
+    def fake(self):
+        fd = FakeDaemon()
+        daemon_call.set_daemon_call_handler(fd)
+        yield fd
+        daemon_call.set_daemon_call_handler(None)
+
+    def test_quota_cycle(self, fake):
+        with task_quota(lightweight=True) as ok:
+            assert ok and fake.quota_held == 1
+        assert fake.quota_held == 0
+
+    def test_no_daemon_means_no_quota(self):
+        daemon_call.set_daemon_call_handler(
+            lambda m, p, b: DaemonResponse(-1, b""))
+        try:
+            assert not acquire_task_quota(lightweight=True, timeout_s=0.2)
+        finally:
+            daemon_call.set_daemon_call_handler(None)
+
+    def test_submit_reports_digest_then_succeeds(self, fake, tmp_path):
+        comp = tmp_path / "g++"
+        comp.write_bytes(b"#!/bin/sh\n")
+        tid = compilation_saas.submit_compilation_task(
+            compiler_path=str(comp),
+            source_path="a.cc",
+            source_digest="sd",
+            compressed_source=compress.compress(b"SRC"),
+            invocation_arguments="-O2",
+            cache_control=1,
+        )
+        assert tid == 1
+        assert str(comp) in fake.digests  # 400 path exercised
+
+    def test_wait_decompress_and_patch(self, fake, tmp_path):
+        comp = tmp_path / "g++"
+        comp.write_bytes(b"x")
+        tid = compilation_saas.submit_compilation_task(
+            compiler_path=str(comp), source_path="a.cc", source_digest="s",
+            compressed_source=compress.compress(b"SRCBYTES"),
+            invocation_arguments="", cache_control=0)
+        result, patches = compilation_saas.wait_for_compilation_task(tid)
+        assert result.exit_code == 0
+        assert result.files[".o"].startswith(b"OBJECT")
+        patched = compilation_saas.apply_path_patches(
+            result.files, patches, client_dir="/my")
+        # Region of 6 bytes replaced by "/my" + "OB" + NUL padding.
+        assert patched[".o"].startswith(b"/myOB\x00")
+
+
+class TestWriteResults:
+    def test_placement(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        args = CompilerArgs.parse(
+            ["g++", "-c", "src/foo.cc", "-o", "out/foo.o"])
+        (tmp_path / "out").mkdir()
+        compilation_saas.write_compilation_results(
+            {".o": b"OBJ", ".gcno": b"NOTES"}, args)
+        assert (tmp_path / "out/foo.o").read_bytes() == b"OBJ"
+        assert (tmp_path / "out/foo.gcno").read_bytes() == b"NOTES"

@@ -1,0 +1,337 @@
+"""Daemon glue + servant-side tests (cache format, sysinfo, execution
+engine with real subprocesses, compiler registry with fake toolchains,
+cloud C++ task, DaemonService over the mock transport)."""
+
+import os
+import pathlib
+import time
+
+import pytest
+
+from yadcc_tpu import api
+from yadcc_tpu.common import compress
+from yadcc_tpu.daemon import cache_format, packing, task_digest
+from yadcc_tpu.daemon.cloud import cxx_task as cloud_cxx
+from yadcc_tpu.daemon.cloud.compiler_registry import CompilerRegistry
+from yadcc_tpu.daemon.cloud.execution_engine import (
+    ExecutionEngine,
+    decide_capacity,
+)
+from yadcc_tpu.daemon.config import DaemonConfig
+from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
+from yadcc_tpu.daemon.sysinfo import LoadAverageSampler
+from yadcc_tpu.rpc import Channel, RpcError
+
+TESTDATA = pathlib.Path(__file__).parent / "testdata"
+
+
+class TestTaskDigest:
+    def test_stable_and_sensitive(self):
+        d = task_digest.get_cxx_task_digest("c1", "-O2", "s1")
+        assert d == task_digest.get_cxx_task_digest("c1", "-O2", "s1")
+        assert d != task_digest.get_cxx_task_digest("c2", "-O2", "s1")
+        assert d != task_digest.get_cxx_task_digest("c1", "-O3", "s1")
+        assert d != task_digest.get_cxx_task_digest("c1", "-O2", "s2")
+
+
+class TestCacheFormat:
+    def _entry(self):
+        return cache_format.CacheEntry(
+            exit_code=0,
+            standard_output=b"out",
+            standard_error=b"warn: x\xff",
+            files={".o": b"OBJ", ".gcno": b"NOTES"},
+            patches={".o": [(4, 32, b"/output.o")]},
+        )
+
+    def test_roundtrip(self):
+        data = cache_format.write_cache_entry(self._entry())
+        parsed = cache_format.try_parse_cache_entry(data)
+        assert parsed is not None
+        assert parsed.exit_code == 0
+        assert parsed.standard_error == b"warn: x\xff"
+        assert parsed.files == {".o": b"OBJ", ".gcno": b"NOTES"}
+        assert parsed.patches == {".o": [(4, 32, b"/output.o")]}
+
+    def test_corruption_is_a_miss(self):
+        data = bytearray(cache_format.write_cache_entry(self._entry()))
+        data[-1] ^= 0xFF  # flip a payload byte -> files_digest mismatch
+        assert cache_format.try_parse_cache_entry(bytes(data)) is None
+        assert cache_format.try_parse_cache_entry(b"garbage") is None
+        assert cache_format.try_parse_cache_entry(b"") is None
+
+    def test_key_prefix(self):
+        key = cache_format.get_cache_key("c", "-O2", "s")
+        assert key.startswith("ytpu-cxx1-entry-")
+
+
+class TestPacking:
+    def test_roundtrip(self):
+        buffers = {".o": b"bytes1", ".gcno": b"", "weird key": b"\x00\x01"}
+        data = packing.pack_keyed_buffers(buffers)
+        assert packing.try_unpack_keyed_buffers(data) == buffers
+
+    def test_malformed(self):
+        assert packing.try_unpack_keyed_buffers(b"junk") is None
+
+
+class TestSysinfo:
+    def test_loadavg_from_synthetic_samples(self):
+        s = LoadAverageSampler(nprocs=8)
+        s._samples.clear()
+        # 10 seconds, 50% busy on 8 cores -> load 4.
+        for i in range(11):
+            total = 1000.0 * i * 8
+            idle = total * 0.5
+            s._samples.append((total, idle))
+        assert s.loadavg(10) == 4
+
+    def test_real_proc_sampling(self):
+        s = LoadAverageSampler()
+        s.sample()
+        assert 0 <= s.loadavg(15) <= s.nprocs
+
+
+class TestCapacityPolicy:
+    def test_dedicated_fraction(self):
+        cap, reason = decide_capacity(64, True, cgroup_present=False)
+        assert reason == 0 and cap == int(64 * 0.95)
+
+    def test_user_fraction(self):
+        cap, reason = decide_capacity(64, False, cgroup_present=False)
+        assert reason == 0 and cap == int(64 * 0.40)
+
+    def test_poor_machine(self):
+        cap, reason = decide_capacity(8, True, cgroup_present=False)
+        assert cap == 0 and reason == 2
+
+    def test_cgroup(self):
+        cap, reason = decide_capacity(64, True, cgroup_present=True)
+        assert cap == 0 and reason == 3
+
+
+class TestExecutionEngine:
+    def _engine(self, conc=4, mem=1 << 40):
+        return ExecutionEngine(max_concurrency=conc,
+                               min_memory_for_new_task=1,
+                               memory_reader=lambda: mem)
+
+    def test_run_and_capture(self):
+        e = self._engine()
+        got = {}
+        tid = e.try_queue_task(
+            grant_id=1, digest="d", cmdline="echo hello; echo err >&2",
+            on_completion=lambda task_id, out: got.update(
+                {"id": task_id, "out": out}))
+        assert tid is not None
+        out = e.wait_for_task(tid, 10.0)
+        assert out is not None and out.exit_code == 0
+        assert out.standard_output == b"hello\n"
+        assert out.standard_error == b"err\n"
+        assert got["id"] == tid
+        e.stop()
+
+    def test_admission_concurrency(self):
+        e = self._engine(conc=1)
+        t1 = e.try_queue_task(grant_id=1, digest="a", cmdline="sleep 5",
+                              on_completion=lambda *_: None)
+        t2 = e.try_queue_task(grant_id=2, digest="b", cmdline="echo x",
+                              on_completion=lambda *_: None)
+        assert t1 is not None and t2 is None
+        e.stop()
+
+    def test_admission_memory(self):
+        e = self._engine()
+        e._min_memory = 1 << 50
+        assert e.try_queue_task(grant_id=1, digest="a", cmdline="echo x",
+                                on_completion=lambda *_: None) is None
+
+    def test_kill_expired_grants(self):
+        e = self._engine()
+        tid = e.try_queue_task(grant_id=77, digest="d", cmdline="sleep 1000",
+                               on_completion=lambda *_: None)
+        proc = e._tasks[tid].proc
+        e.kill_expired_tasks([77])
+        deadline = time.time() + 5
+        while proc.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert proc.poll() is not None  # process group is dead
+        assert not e.is_known(tid)
+
+    def test_refcount_free(self):
+        e = self._engine()
+        tid = e.try_queue_task(grant_id=1, digest="d", cmdline="echo x",
+                               on_completion=lambda *_: None)
+        assert e.wait_for_task(tid, 5.0) is not None
+        assert e.reference_task(tid)
+        e.free_task(tid)            # drops to 1
+        assert e.is_known(tid)
+        e.free_task(tid)            # drops to 0 -> gone
+        assert not e.is_known(tid)
+
+    def test_find_by_digest(self):
+        e = self._engine()
+        tid = e.try_queue_task(grant_id=1, digest="dup", cmdline="sleep 2",
+                               on_completion=lambda *_: None)
+        assert e.find_task_by_digest("dup") == tid
+        assert e.find_task_by_digest("nope") is None
+        e.stop()
+
+
+class TestCompilerRegistry:
+    def test_scan_fake_toolchain(self, monkeypatch):
+        monkeypatch.setenv("PATH", str(TESTDATA / "toolchains" / "bin"))
+        r = CompilerRegistry()
+        envs = r.environments()
+        # Only the real fake-g++ registers: the ccache symlink and the
+        # broken clang symlink are skipped.
+        assert len(envs) == 1
+        path = r.try_get_compiler_path(envs[0])
+        assert path.endswith("g++")
+        assert r.try_get_compiler_path("0" * 64) is None
+
+
+class TestCloudCxxTask:
+    def test_cacheability_scan(self):
+        assert cloud_cxx.scan_source_cacheability(b"int x;", "-O2")
+        assert not cloud_cxx.scan_source_cacheability(
+            b'char t[] = __TIME__;', "-O2")
+        assert cloud_cxx.scan_source_cacheability(
+            b'char t[] = __TIME__;', '-O2 -D__TIME__="x"')
+
+    def test_find_patch_locations(self):
+        ws = b"/dev/shm/ytpu_cxx_abc" + b"p" * 50
+        data = b"head" + ws + b"/src.cc\x00middle" + ws + b"/output.o\x00end"
+        locs = cloud_cxx.find_patch_locations(data, ws)
+        assert len(locs) == 2
+        pos, total, suffix = locs[0]
+        assert data[pos : pos + len(ws)] == ws
+        assert suffix == b"/src.cc"
+        assert locs[1][2] == b"/output.o"
+
+    def test_prepare_and_collect(self, tmp_path):
+        task = cloud_cxx.CloudCxxCompilationTask(
+            compiler_path=str(TESTDATA / "fake-g++"),
+            compiler_digest="cd",
+            invocation_arguments="-O2",
+            source_path="/home/user/proj/a.cc",
+            temp_root=str(tmp_path),
+        )
+        task.prepare(compress.compress(b"int main() { return 0; }"))
+        assert len(task.workspace.path) == cloud_cxx._PADDED_WORKSPACE_LEN
+        assert "-x c++-cpp-output" in task.cmdline
+        # Run the fake compiler exactly as the engine would.
+        import subprocess
+
+        p = subprocess.run(["sh", "-c", task.cmdline], capture_output=True)
+        assert p.returncode == 0, p.stderr
+        from yadcc_tpu.daemon.cloud.execution_engine import TaskOutput
+
+        files, patches, entry_bytes = task.collect_outputs(
+            TaskOutput(0, p.stdout, p.stderr))
+        assert set(files) == {".o", ".gcno"}
+        # The fake compiler embeds the workspace dir: patches must be found.
+        assert ".o" in patches and ".gcno" in patches
+        # Cache entry parses back.
+        entry = cache_format.try_parse_cache_entry(entry_bytes)
+        assert entry is not None and entry.files.keys() == files.keys()
+        # Workspace cleaned up.
+        assert not os.path.exists(task.workspace.path)
+
+
+class TestDaemonService:
+    @pytest.fixture
+    def service(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATH", str(TESTDATA / "toolchains" / "bin"))
+        config = DaemonConfig(temporary_dir=str(tmp_path),
+                              location="127.0.0.1:8335")
+        engine = ExecutionEngine(max_concurrency=4,
+                                 min_memory_for_new_task=1)
+        registry = CompilerRegistry()
+        svc = DaemonService(config, engine=engine, registry=registry,
+                            allow_poor_machine=True, cgroup_present=False)
+        svc.set_acceptable_tokens_for_testing(["tok"])
+        from yadcc_tpu.rpc import register_mock_server, unregister_mock_server
+
+        register_mock_server("servant", svc.spec())
+        yield svc
+        unregister_mock_server("servant")
+        engine.stop()
+
+    def _queue(self, ch, svc, source=b"int main(){return 0;}", args="-O2",
+               token="tok"):
+        req = api.daemon.QueueCxxCompilationTaskRequest(
+            token=token,
+            task_grant_id=5,
+            source_path="/src/x.cc",
+            invocation_arguments=args,
+            compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD,
+        )
+        req.env_desc.compiler_digest = svc.registry.environments()[0]
+        resp, _ = ch.call("ytpu.DaemonService", "QueueCxxCompilationTask",
+                          req, api.daemon.QueueCxxCompilationTaskResponse,
+                          attachment=compress.compress(source))
+        return resp.task_id
+
+    def _wait(self, ch, task_id, token="tok"):
+        req = api.daemon.WaitForCompilationOutputRequest(
+            token=token, task_id=task_id, milliseconds_to_wait=8000)
+        req.acceptable_compression_algorithms.append(
+            api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        return ch.call("ytpu.DaemonService", "WaitForCompilationOutput",
+                       req, api.daemon.WaitForCompilationOutputResponse)
+
+    def test_full_compile_flow(self, service):
+        ch = Channel("mock://servant")
+        task_id = self._queue(ch, service)
+        resp, att = self._wait(ch, task_id)
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_DONE
+        assert resp.exit_code == 0
+        files = packing.try_unpack_keyed_buffers(att)
+        assert ".o" in files
+        obj = compress.decompress(files[".o"])
+        assert obj.startswith(b"ELFOBJ:")
+        assert len(resp.cxx_info.patches) >= 1
+        ch.call("ytpu.DaemonService", "FreeTask",
+                api.daemon.FreeDaemonTaskRequest(token="tok",
+                                                 task_id=task_id),
+                api.daemon.FreeDaemonTaskResponse)
+
+    def test_compile_error_propagates(self, service):
+        ch = Channel("mock://servant")
+        task_id = self._queue(ch, service, args="-DFAIL")
+        resp, att = self._wait(ch, task_id)
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_DONE
+        assert resp.exit_code == 1
+        assert b"induced failure" in resp.standard_error
+
+    def test_bad_token(self, service):
+        ch = Channel("mock://servant")
+        with pytest.raises(RpcError) as ei:
+            self._queue(ch, service, token="evil")
+        assert ei.value.status == api.daemon.DAEMON_STATUS_ACCESS_DENIED
+
+    def test_unknown_environment(self, service):
+        ch = Channel("mock://servant")
+        req = api.daemon.QueueCxxCompilationTaskRequest(
+            token="tok", compression_algorithm=2)
+        req.env_desc.compiler_digest = "f" * 64
+        with pytest.raises(RpcError) as ei:
+            ch.call("ytpu.DaemonService", "QueueCxxCompilationTask", req,
+                    api.daemon.QueueCxxCompilationTaskResponse,
+                    attachment=compress.compress(b"x"))
+        assert ei.value.status == (
+            api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE)
+
+    def test_unknown_task_wait(self, service):
+        ch = Channel("mock://servant")
+        resp, _ = self._wait(ch, 99999)
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+
+    def test_dedup_same_digest_joins(self, service):
+        ch = Channel("mock://servant")
+        t1 = self._queue(ch, service, source=b"long" * 10,
+                         args="-Dsleepy && sleep 1")
+        t2 = self._queue(ch, service, source=b"long" * 10,
+                         args="-Dsleepy && sleep 1")
+        assert t1 == t2  # joined, not recompiled

@@ -1,0 +1,424 @@
+"""Delegate-side tests.
+
+Mirrors the reference's key test trick (yadcc/daemon/local/
+distributed_task_dispatcher_test.cc): the ENTIRE scheduler, cache and
+peer-servant services are faked in-process behind mock:// channels, so
+the full submit -> grant -> dispatch -> long-poll -> complete state
+machine runs hermetically.
+"""
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from yadcc_tpu import api
+from yadcc_tpu.common import compress
+from yadcc_tpu.common.multi_chunk import make_multi_chunk, \
+    try_parse_multi_chunk
+from yadcc_tpu.common.token_verifier import TokenVerifier
+from yadcc_tpu.daemon import cache_format, packing
+from yadcc_tpu.daemon.local.config_keeper import ConfigKeeper
+from yadcc_tpu.daemon.local.cxx_task import CxxCompilationTask
+from yadcc_tpu.daemon.local.distributed_cache_reader import \
+    DistributedCacheReader
+from yadcc_tpu.daemon.local.distributed_task_dispatcher import \
+    DistributedTaskDispatcher
+from yadcc_tpu.daemon.local.file_digest_cache import FileDigestCache
+from yadcc_tpu.daemon.local.http_service import LocalHttpService
+from yadcc_tpu.daemon.local.local_task_monitor import LocalTaskMonitor
+from yadcc_tpu.daemon.local.running_task_keeper import RunningTaskKeeper
+from yadcc_tpu.daemon.local.task_grant_keeper import TaskGrantKeeper
+from yadcc_tpu.rpc import (
+    RpcContext,
+    RpcError,
+    ServiceSpec,
+    register_mock_server,
+    unregister_mock_server,
+)
+from yadcc_tpu.scheduler.policy import GreedyCpuPolicy
+from yadcc_tpu.scheduler.service import SchedulerService
+from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+
+ENV = "11" * 32
+
+
+class FakeServant:
+    """Minimal in-process DaemonService: executes nothing, returns a
+    canned object for every queued task."""
+
+    def __init__(self):
+        self.queued = 0
+        self.referenced = 0
+        self.freed = 0
+        self._next = 1
+        self._running = {}
+
+    def spec(self) -> ServiceSpec:
+        s = ServiceSpec("ytpu.DaemonService")
+        s.add("QueueCxxCompilationTask",
+              api.daemon.QueueCxxCompilationTaskRequest, self.queue)
+        s.add("ReferenceTask", api.daemon.ReferenceTaskRequest, self.ref)
+        s.add("WaitForCompilationOutput",
+              api.daemon.WaitForCompilationOutputRequest, self.wait)
+        s.add("FreeTask", api.daemon.FreeDaemonTaskRequest, self.free)
+        return s
+
+    def queue(self, req, att, ctx):
+        self.queued += 1
+        tid = self._next
+        self._next += 1
+        self._running[tid] = compress.decompress(att)
+        return api.daemon.QueueCxxCompilationTaskResponse(task_id=tid)
+
+    def ref(self, req, att, ctx):
+        if req.task_id not in self._running:
+            raise RpcError(api.daemon.DAEMON_STATUS_TASK_NOT_FOUND, "")
+        self.referenced += 1
+        return api.daemon.ReferenceTaskResponse()
+
+    def wait(self, req, att, ctx: RpcContext):
+        resp = api.daemon.WaitForCompilationOutputResponse()
+        if req.task_id not in self._running:
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+            return resp
+        resp.status = api.daemon.COMPILATION_TASK_STATUS_DONE
+        resp.exit_code = 0
+        resp.standard_output = b"remote ok"
+        resp.compression_algorithm = api.daemon.COMPRESSION_ALGORITHM_ZSTD
+        ctx.response_attachment = packing.pack_keyed_buffers(
+            {".o": compress.compress(b"OBJ:" + self._running[req.task_id])})
+        return resp
+
+    def free(self, req, att, ctx):
+        self.freed += 1
+        self._running.pop(req.task_id, None)
+        return api.daemon.FreeDaemonTaskResponse()
+
+
+@pytest.fixture
+def cluster():
+    """Scheduler + fake servant + (optional) cache, all behind mock://."""
+    sched_dispatcher = TaskDispatcher(
+        GreedyCpuPolicy(), max_servants=16, max_envs=64, batch_window_s=0.0)
+    sched = SchedulerService(sched_dispatcher)
+    servant = FakeServant()
+    register_mock_server("sched", sched.spec())
+    register_mock_server("servant1", servant.spec())
+    sched_dispatcher.keep_servant_alive(
+        ServantInfo(location="mock://servant1", version=1,
+                    num_processors=32, capacity=8,
+                    total_memory=64 << 30, memory_available=64 << 30,
+                    env_digests=(ENV,)),
+        expires_in_s=1000)
+    yield {"sched": sched, "servant": servant,
+           "dispatcher": sched_dispatcher}
+    unregister_mock_server("sched")
+    unregister_mock_server("servant1")
+    sched_dispatcher.stop()
+
+
+def make_task(source=b"int x;", args="-O2", cache_control=0, pid=0):
+    return CxxCompilationTask(
+        requestor_pid=pid,
+        source_path="/src/a.cc",
+        source_digest=str(hash(source)),
+        invocation_arguments=args,
+        cache_control=cache_control,
+        compiler_digest=ENV,
+        compressed_source=compress.compress(source),
+    )
+
+
+class TestLocalTaskMonitor:
+    def test_classes_have_separate_limits(self):
+        m = LocalTaskMonitor(nprocs=4, pid_prober=lambda pid: True)
+        # heavy limit = 2, light limit = 6.
+        assert m.wait_for_running_new_task_permission(1, False, 0.1)
+        assert m.wait_for_running_new_task_permission(1, False, 0.1)
+        assert not m.wait_for_running_new_task_permission(1, False, 0.1)
+        for _ in range(6):
+            assert m.wait_for_running_new_task_permission(1, True, 0.1)
+        assert not m.wait_for_running_new_task_permission(1, True, 0.1)
+
+    def test_release_unblocks(self):
+        m = LocalTaskMonitor(nprocs=2, pid_prober=lambda pid: True)
+        assert m.wait_for_running_new_task_permission(7, False, 0.1)
+        got = []
+        t = threading.Thread(target=lambda: got.append(
+            m.wait_for_running_new_task_permission(8, False, 5.0)))
+        t.start()
+        time.sleep(0.1)
+        m.drop_task_permission(7)
+        t.join(timeout=5)
+        assert got == [True]
+
+    def test_dead_pid_reclaimed(self):
+        alive = {1: True}
+        m = LocalTaskMonitor(nprocs=2,
+                             pid_prober=lambda pid: alive.get(pid, False))
+        assert m.wait_for_running_new_task_permission(1, False, 0.1)
+        alive[1] = False
+        assert m.on_reclaim_timer() == 1
+        assert m.inspect()["heavy_held"] == 0
+
+
+class TestFileDigestCache:
+    def test_memo(self):
+        c = FileDigestCache()
+        assert c.try_get("/bin/g++", 100, 5) is None
+        c.set("/bin/g++", 100, 5, "abc")
+        assert c.try_get("/bin/g++", 100, 5) == "abc"
+        assert c.try_get("/bin/g++", 100, 6) is None  # mtime changed
+
+
+class TestGrantKeeper(object):
+    def test_get_and_prefetch(self, cluster):
+        k = TaskGrantKeeper("mock://sched", token="")
+        g = k.get(ENV, timeout_s=5.0)
+        assert g is not None
+        assert g.servant_location == "mock://servant1"
+        # The fetcher asked for waiters+1: a prefetched grant should be
+        # queued for the next call to consume instantly.
+        t0 = time.monotonic()
+        g2 = k.get(ENV, timeout_s=5.0)
+        assert g2 is not None and g2.grant_id != g.grant_id
+        k.free([g.grant_id, g2.grant_id])
+        k.stop()
+
+    def test_keep_alive(self, cluster):
+        k = TaskGrantKeeper("mock://sched", token="")
+        g = k.get(ENV, timeout_s=5.0)
+        assert k.keep_alive([g.grant_id]) == [True]
+        assert k.keep_alive([999999]) == [False]
+        k.stop()
+
+    def test_unknown_env_times_out(self, cluster):
+        k = TaskGrantKeeper("mock://sched", token="")
+        assert k.get("ff" * 32, timeout_s=0.5) is None
+        k.stop()
+
+
+class TestConfigKeeper:
+    def test_pulls_token(self, cluster):
+        ck = ConfigKeeper("mock://sched", token="")
+        ck.refresh_once()
+        tok = ck.serving_daemon_token()
+        assert tok and tok in cluster["sched"].daemon_tokens.acceptable()
+
+
+class TestRunningTaskKeeper:
+    def test_snapshot(self, cluster):
+        cluster["sched"].bookkeeper.set_servant_running_tasks(
+            "mock://servant1",
+            [__import__("yadcc_tpu.scheduler.running_task_bookkeeper",
+                        fromlist=["RunningTaskRecord"]).RunningTaskRecord(
+                servant_task_id=4, task_grant_id=9,
+                servant_location="mock://servant1", task_digest="DG")])
+        rk = RunningTaskKeeper("mock://sched")
+        rk.refresh_once()
+        found = rk.try_find_task("DG")
+        assert found is not None and found.servant_task_id == 4
+        assert rk.try_find_task("other") is None
+
+
+class TestDispatcherFlows:
+    def _mk(self, cluster, cache_reader=None, running_keeper=None,
+            pid_prober=None):
+        ck = ConfigKeeper("mock://sched", token="")
+        ck.refresh_once()
+        return DistributedTaskDispatcher(
+            grant_keeper=TaskGrantKeeper("mock://sched", token=""),
+            config_keeper=ck,
+            cache_reader=cache_reader,
+            running_task_keeper=running_keeper,
+            pid_prober=pid_prober or (lambda pid: True),
+        )
+
+    def test_dispatch_and_complete(self, cluster):
+        d = self._mk(cluster)
+        tid = d.queue_task(make_task())
+        result = d.wait_for_task(tid, timeout_s=10.0)
+        assert result is not None and result.exit_code == 0
+        assert result.standard_output == b"remote ok"
+        assert compress.decompress(result.files[".o"]).startswith(b"OBJ:")
+        assert cluster["servant"].queued == 1
+        assert cluster["servant"].freed == 1
+        assert d.stats["actually_run"] == 1
+        # The task's own grant is freed back; at most the keeper's one
+        # prefetched grant may remain outstanding (by design — it covers
+        # the next task and expires by lease otherwise).
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                cluster["dispatcher"].inspect()["grants_outstanding"] > 1:
+            time.sleep(0.05)
+        assert cluster["dispatcher"].inspect()["grants_outstanding"] <= 1
+
+    def test_cache_hit_skips_servant(self, cluster):
+        entry = cache_format.write_cache_entry(cache_format.CacheEntry(
+            exit_code=0, standard_output=b"cached", standard_error=b"",
+            files={".o": compress.compress(b"CACHEDOBJ")}))
+
+        class FakeReader:
+            enabled = True
+
+            def try_read(self, key):
+                return entry
+
+        d = self._mk(cluster, cache_reader=FakeReader())
+        tid = d.queue_task(make_task(cache_control=1))
+        result = d.wait_for_task(tid, timeout_s=10.0)
+        assert result.from_cache
+        assert result.standard_output == b"cached"
+        assert cluster["servant"].queued == 0
+        assert d.stats["hit_cache"] == 1
+
+    def test_join_running_task(self, cluster):
+        # Pre-seed the fake servant with task 1 and advertise it.
+        servant = cluster["servant"]
+        servant._running[1] = b"shared source"
+        task = make_task(source=b"shared source")
+        cluster["sched"].bookkeeper.set_servant_running_tasks(
+            "mock://servant1",
+            [__import__("yadcc_tpu.scheduler.running_task_bookkeeper",
+                        fromlist=["RunningTaskRecord"]).RunningTaskRecord(
+                servant_task_id=1, task_grant_id=3,
+                servant_location="mock://servant1",
+                task_digest=task.get_digest())])
+        rk = RunningTaskKeeper("mock://sched")
+        rk.refresh_once()
+        d = self._mk(cluster, running_keeper=rk)
+        tid = d.queue_task(task)
+        result = d.wait_for_task(tid, timeout_s=10.0)
+        assert result is not None and result.exit_code == 0
+        assert servant.referenced == 1
+        assert servant.queued == 0  # joined, never re-queued
+        assert d.stats["reused"] == 1
+
+    def test_orphan_kill_on_dead_pid(self, cluster):
+        alive = {123: True}
+        d = self._mk(cluster, pid_prober=lambda p: alive.get(p, True))
+        # Block the servant wait forever by making the task unknown.
+        cluster["servant"]._running.clear()
+
+        class SlowServant:
+            pass
+
+        tid = d.queue_task(make_task(pid=123))
+        time.sleep(0.2)
+        alive[123] = False
+        for _ in range(3):
+            d.on_timer()
+        result = d.wait_for_task(tid, timeout_s=10.0)
+        assert result is not None  # aborted -> error result, not a hang
+
+
+class TestHttpService:
+    @pytest.fixture
+    def http_daemon(self, cluster):
+        d = DistributedTaskDispatcher(
+            grant_keeper=TaskGrantKeeper("mock://sched", token=""),
+            config_keeper=self._ck(),
+            pid_prober=lambda pid: True,
+        )
+        svc = LocalHttpService(
+            monitor=LocalTaskMonitor(nprocs=4, pid_prober=lambda p: True),
+            digest_cache=FileDigestCache(),
+            dispatcher=d,
+            port=0,
+        )
+        svc.start()
+        yield svc
+        svc.stop()
+
+    def _ck(self):
+        ck = ConfigKeeper("mock://sched", token="")
+        ck.refresh_once()
+        return ck
+
+    def _post(self, svc, path, body):
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=15)
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/octet-stream"})
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    def test_get_version(self, http_daemon):
+        conn = http.client.HTTPConnection("127.0.0.1", http_daemon.port,
+                                          timeout=5)
+        conn.request("GET", "/local/get_version")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert b"version_for_upgrade" in resp.read()
+        conn.close()
+
+    def test_quota_cycle(self, http_daemon):
+        code, _ = self._post(
+            http_daemon, "/local/acquire_quota",
+            b'{"milliseconds_to_wait": 500, "lightweight_task": false, '
+            b'"requestor_pid": 42}')
+        assert code == 200
+        code, _ = self._post(http_daemon, "/local/release_quota",
+                             b'{"requestor_pid": 42}')
+        assert code == 200
+
+    def test_quota_timeout_503(self, http_daemon):
+        for pid in (1, 2):  # heavy limit = 2 at nprocs 4
+            code, _ = self._post(
+                http_daemon, "/local/acquire_quota",
+                b'{"milliseconds_to_wait": 300, "lightweight_task": false, '
+                b'"requestor_pid": %d}' % pid)
+            assert code == 200
+        code, _ = self._post(
+            http_daemon, "/local/acquire_quota",
+            b'{"milliseconds_to_wait": 200, "lightweight_task": false, '
+            b'"requestor_pid": 3}')
+        assert code == 503
+
+    def test_submit_requires_digest_then_succeeds(self, http_daemon):
+        submit = {
+            "requestor_process_id": 1,
+            "source_path": "/src/a.cc",
+            "source_digest": "sd",
+            "compiler_invocation_arguments": "-O2",
+            "cache_control": 0,
+            "compiler": {"path": "/usr/bin/g++", "size": "123",
+                         "timestamp": "456"},
+        }
+        import json
+
+        body = make_multi_chunk([json.dumps(submit).encode(),
+                                 compress.compress(b"src")])
+        code, data = self._post(http_daemon, "/local/submit_cxx_task", body)
+        assert code == 400  # digest unknown yet
+        code, _ = self._post(
+            http_daemon, "/local/set_file_digest",
+            json.dumps({
+                "file_desc": {"path": "/usr/bin/g++", "size": "123",
+                              "timestamp": "456"},
+                "digest": ENV,
+            }).encode())
+        assert code == 200
+        code, data = self._post(http_daemon, "/local/submit_cxx_task", body)
+        assert code == 200
+        task_id = json.loads(data)["task_id"]
+
+        code, data = self._post(
+            http_daemon, "/local/wait_for_cxx_task",
+            json.dumps({"task_id": task_id,
+                        "milliseconds_to_wait": 9000}).encode())
+        assert code == 200
+        chunks = try_parse_multi_chunk(data)
+        meta = json.loads(chunks[0])
+        assert meta["exit_code"] == 0
+        assert meta["file_extensions"] == [".o"]
+        assert compress.decompress(chunks[1]).startswith(b"OBJ:")
+
+    def test_wait_unknown_task_404(self, http_daemon):
+        code, _ = self._post(
+            http_daemon, "/local/wait_for_cxx_task",
+            b'{"task_id": "424242", "milliseconds_to_wait": 100}')
+        assert code == 404

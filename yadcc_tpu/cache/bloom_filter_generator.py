@@ -1,0 +1,144 @@
+"""Bloom-filter generator for the cache server.
+
+Parity with reference yadcc/cache/bloom_filter_generator.{h,cc}: a salted
+filter sized for 1M keys at 1e-5 false-positive rate (27,584,639 bits /
+10 hashes — bloom_filter_generator.h:64-68), plus a time-stamped deque of
+newly added keys covering the last hour so clients can sync
+incrementally; periodic Rebuild() re-populates from the engine's key
+enumeration with a compensation window (bloom_filter_generator.cc:25-41)
+so keys added *during* the rebuild are not lost.
+
+A DeviceBloomReplica mirrors the filter's words onto the accelerator so
+million-key batches resolve in one kernel call (the north-star's device
+path; see ops/bloom_probe.py).
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+from collections import deque
+from typing import Deque, Iterable, List, Optional, Tuple
+
+from ..common import bloom
+from ..utils.clock import REAL_CLOCK, Clock
+
+# Keep an hour of incremental keys (reference :70-82).
+_NEW_KEY_RETENTION_S = 3600.0
+
+
+class BloomFilterGenerator:
+    def __init__(
+        self,
+        num_bits: int = bloom.DEFAULT_NUM_BITS,
+        num_hashes: int = bloom.DEFAULT_NUM_HASHES,
+        clock: Clock = REAL_CLOCK,
+        salt: Optional[int] = None,
+    ):
+        self._clock = clock
+        self._salt = (secrets.randbits(32) if salt is None else salt)
+        self._num_bits = num_bits
+        self._num_hashes = num_hashes
+        self._lock = threading.Lock()
+        self._filter = bloom.SaltedBloomFilter(num_bits, num_hashes,
+                                               self._salt)
+        self._new_keys: Deque[Tuple[float, str]] = deque()
+
+    @property
+    def num_hashes(self) -> int:
+        return self._num_hashes
+
+    @property
+    def salt(self) -> int:
+        return self._salt
+
+    def add(self, key: str) -> None:
+        now = self._clock.now()
+        with self._lock:
+            self._filter.add(key)
+            self._new_keys.append((now, key))
+            self._trim_locked(now)
+
+    def get_newly_populated_keys(self, within_s: float) -> List[str]:
+        """Keys added in the last `within_s` seconds (for incremental
+        client sync; the caller adds its own compensation margin)."""
+        now = self._clock.now()
+        with self._lock:
+            self._trim_locked(now)
+            cutoff = now - within_s
+            return [k for t, k in self._new_keys if t >= cutoff]
+
+    def can_serve_incremental(self, within_s: float) -> bool:
+        """The deque only reaches back _NEW_KEY_RETENTION_S; older sync
+        points require a full fetch."""
+        return within_s < _NEW_KEY_RETENTION_S
+
+    def rebuild(self, keys: Iterable[str]) -> None:
+        """Repopulate from an authoritative key enumeration.
+
+        Runs off the request path (60s timer in the service).  The new
+        filter is built aside, then keys that arrived during the rebuild
+        (still in the deque — the compensation window) are merged before
+        the swap, so no concurrent Put is lost.
+        """
+        fresh = bloom.SaltedBloomFilter(self._num_bits, self._num_hashes,
+                                        self._salt)
+        for k in keys:
+            fresh.add(k)
+        now = self._clock.now()
+        with self._lock:
+            self._trim_locked(now)
+            for _, k in self._new_keys:
+                fresh.add(k)
+            self._filter = fresh
+
+    def filter_bytes(self) -> bytes:
+        with self._lock:
+            return self._filter.to_bytes()
+
+    def may_contain(self, key: str) -> bool:
+        with self._lock:
+            return self._filter.may_contain(key)
+
+    def fill_ratio(self) -> float:
+        with self._lock:
+            return self._filter.fill_ratio()
+
+    def _trim_locked(self, now: float) -> None:
+        cutoff = now - _NEW_KEY_RETENTION_S
+        while self._new_keys and self._new_keys[0][0] < cutoff:
+            self._new_keys.popleft()
+
+
+class DeviceBloomReplica:
+    """Accelerator-resident mirror of a Bloom filter for batch probes.
+
+    Used by the daemon's DistributedCacheReader for large key batches and
+    by the benchmark (BASELINE.json configs[3]): upload once per sync,
+    then each [N]-key batch is one jitted gather on device.
+    """
+
+    def __init__(self, filter_data: bytes, num_hashes: int, salt: int,
+                 num_bits: int = bloom.DEFAULT_NUM_BITS):
+        import jax.numpy as jnp
+
+        self._host = bloom.SaltedBloomFilter.from_bytes(
+            filter_data, num_hashes, salt, num_bits=num_bits)
+        self._words_dev = jnp.asarray(self._host.words)
+        self._salt = salt
+
+    def may_contain_batch(self, keys: List[str]):
+        """bool numpy array [len(keys)] via one device call."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.bloom_probe import bloom_may_contain
+
+        if not keys:
+            return np.zeros(0, bool)
+        fps = bloom.key_fingerprints(keys, self._salt)
+        out = bloom_may_contain(
+            self._words_dev, jnp.asarray(fps),
+            num_bits=self._host.num_bits,
+            num_hashes=self._host.num_hashes)
+        return np.asarray(out)

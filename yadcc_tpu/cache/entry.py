@@ -1,0 +1,93 @@
+"""Cache server main.
+
+Parity with reference yadcc/cache/entry.cc (port 8337, disk-IO-friendly
+worker pool, 128MB packet cap enforced in the service).  Run:
+
+    python -m yadcc_tpu.cache.entry --cache-engine disk \
+        --cache-dirs /var/cache/ytpu1,/var/cache/ytpu2
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+
+from ..common.parse_size import parse_size
+from ..common.token_verifier import make_token_verifier_from_flag
+from ..rpc import GrpcServer
+from ..utils import exposed_vars
+from ..utils.inspect_server import InspectServer
+from ..utils.logging import get_logger
+from . import disk_engine, object_store_engine  # noqa: F401 (register)
+from .cache_engine import make_engine
+from .in_memory_cache import InMemoryCache
+from .service import CacheService
+
+logger = get_logger("cache.entry")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("yadcc-tpu-cache")
+    p.add_argument("--port", type=int, default=8337)
+    p.add_argument("--inspect-port", type=int, default=9337)
+    p.add_argument("--inspect-credential", default="")
+    p.add_argument("--cache-engine", default="null",
+                   choices=["disk", "null", "objstore"])
+    p.add_argument("--cache-dirs", default="",
+                   help="comma-separated shard dirs (disk) or root (objstore)")
+    p.add_argument("--l2-capacity", default="64G")
+    p.add_argument("--l1-capacity", default="4G")
+    p.add_argument("--acceptable-user-tokens", default="")
+    p.add_argument("--acceptable-servant-tokens", default="")
+    return p
+
+
+def cache_server_start(args) -> None:
+    if args.cache_engine == "disk":
+        l2 = make_engine("disk", dirs=args.cache_dirs,
+                         capacity=parse_size(args.l2_capacity))
+    elif args.cache_engine == "objstore":
+        l2 = make_engine("objstore", root=args.cache_dirs,
+                         capacity=parse_size(args.l2_capacity))
+    else:
+        l2 = make_engine("null")
+    service = CacheService(
+        InMemoryCache(parse_size(args.l1_capacity)),
+        l2,
+        user_tokens=make_token_verifier_from_flag(
+            args.acceptable_user_tokens),
+        servant_tokens=make_token_verifier_from_flag(
+            args.acceptable_servant_tokens),
+    )
+    exposed_vars.expose("yadcc/cache", service.inspect)
+
+    server = GrpcServer(f"0.0.0.0:{args.port}", max_workers=32)
+    server.add_service(service.spec())
+    server.start()
+    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect.start()
+    logger.info("cache server on :%d (engine=%s), inspect on :%d",
+                args.port, l2.name, inspect.port)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    last_rebuild = time.monotonic()
+    while not stop.is_set():
+        time.sleep(1.0)
+        if time.monotonic() - last_rebuild >= 60.0:
+            service.rebuild_bloom_filter()
+            last_rebuild = time.monotonic()
+    server.stop()
+    inspect.stop()
+    l2.stop()
+
+
+def main() -> None:
+    cache_server_start(build_arg_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Object-store L2 engine (the COS-engine analogue).
+
+Parity with reference yadcc/cache/cos_cache_engine.{h,cc}: the reference
+persists its L2 in Tencent Cloud COS via flare's CosClient.  This
+framework has no vendor SDK (and the build environment has zero egress),
+so the engine is written against a minimal ObjectStoreBackend interface
+— list/get/put/delete under a key prefix — with a filesystem-backed
+implementation for tests and on-prem NFS-style deployments.  An S3/GCS
+HTTP backend plugs in behind the same four calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..common.hashing import digest_bytes
+from .cache_engine import CacheEngine, register_engine
+
+
+class ObjectStoreBackend:
+    def get(self, name: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, name: str) -> None:
+        raise NotImplementedError
+
+    def list(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FsObjectStoreBackend(ObjectStoreBackend):
+    """Objects as files under a root dir (tests / shared-filesystem use)."""
+
+    def __init__(self, root: str):
+        self._root = Path(root)
+        self._root.mkdir(parents=True, exist_ok=True)
+
+    def get(self, name: str) -> Optional[bytes]:
+        try:
+            return (self._root / name).read_bytes()
+        except FileNotFoundError:
+            return None
+
+    def put(self, name: str, data: bytes) -> None:
+        tmp = self._root / f".tmp.{name}.{threading.get_native_id()}"
+        tmp.write_bytes(data)
+        tmp.replace(self._root / name)
+
+    def delete(self, name: str) -> None:
+        (self._root / name).unlink(missing_ok=True)
+
+    def list(self) -> List[str]:
+        return [p.name for p in self._root.iterdir()
+                if p.is_file() and not p.name.startswith(".tmp.")]
+
+
+class ObjectStoreEngine(CacheEngine):
+    """Keys map to object names "<digest>"; the original key string is
+    stored in a small length-prefixed object header so keys() can feed
+    Bloom rebuild without a separate manifest service.  Capacity is
+    enforced approximately with an age-based purge (object stores expose
+    no cheap LRU signal)."""
+
+    name = "objstore"
+
+    _HEADER_MAGIC = b"YTOB"
+
+    def __init__(self, backend: ObjectStoreBackend,
+                 capacity_bytes: int = 64 << 30):
+        self._backend = backend
+        self._capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._sizes: Dict[str, int] = {}  # object name -> size
+        self._touched: Dict[str, float] = {}
+        self._keys: Dict[str, str] = {}   # object name -> original key
+        # One full scan at startup (key strings live in object headers);
+        # afterwards keys() serves from memory — the Bloom rebuild timer
+        # calls it every 60s and must never re-download the store.
+        for name in backend.list():
+            data = backend.get(name)
+            if data is not None:
+                self._sizes[name] = len(data)
+                self._touched[name] = time.time()
+                unpacked = self._unpack(data)
+                if unpacked is not None:
+                    self._keys[name] = unpacked[0]
+
+    @staticmethod
+    def _object_name(key: str) -> str:
+        return digest_bytes(key.encode())
+
+    def _pack(self, key: str, value: bytes) -> bytes:
+        kb = key.encode()
+        return (self._HEADER_MAGIC + len(kb).to_bytes(4, "little") + kb
+                + value)
+
+    def _unpack(self, data: bytes) -> Optional[tuple]:
+        if not data.startswith(self._HEADER_MAGIC):
+            return None
+        klen = int.from_bytes(data[4:8], "little")
+        key = data[8 : 8 + klen].decode(errors="replace")
+        return key, data[8 + klen :]
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        data = self._backend.get(self._object_name(key))
+        if data is None:
+            return None
+        unpacked = self._unpack(data)
+        if unpacked is None:
+            return None
+        with self._lock:
+            self._touched[self._object_name(key)] = time.time()
+        return unpacked[1]
+
+    def put(self, key: str, value: bytes) -> None:
+        name = self._object_name(key)
+        data = self._pack(key, value)
+        self._backend.put(name, data)
+        with self._lock:
+            self._sizes[name] = len(data)
+            self._touched[name] = time.time()
+            self._keys[name] = key
+            self._purge_locked()
+
+    def remove(self, key: str) -> None:
+        name = self._object_name(key)
+        self._backend.delete(name)
+        with self._lock:
+            self._sizes.pop(name, None)
+            self._touched.pop(name, None)
+            self._keys.pop(name, None)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._keys.values())
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"objects": len(self._sizes),
+                    "total_bytes": sum(self._sizes.values()),
+                    "capacity": self._capacity}
+
+    def _purge_locked(self) -> None:
+        total = sum(self._sizes.values())
+        if total <= self._capacity:
+            return
+        for name in sorted(self._sizes, key=lambda n: self._touched.get(n, 0)):
+            if total <= self._capacity:
+                break
+            self._backend.delete(name)
+            total -= self._sizes.pop(name)
+            self._touched.pop(name, None)
+            self._keys.pop(name, None)
+
+
+def _make_objstore(root: str = "", capacity: int = 64 << 30, **kw):
+    if not root:
+        raise ValueError("objstore engine requires --cache-dirs (root)")
+    return ObjectStoreEngine(FsObjectStoreBackend(root), capacity)
+
+
+register_engine("objstore", _make_objstore)

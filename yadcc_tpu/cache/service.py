@@ -1,0 +1,154 @@
+"""CacheService RPC implementation.
+
+Parity with reference yadcc/cache/cache_service_impl.{h,cc}:
+FetchBloomFilter serves either an incremental key list or the full
+zstd-compressed filter depending on the client's sync ages (with a
+per-client jittered ~10min full-fetch interval enforced client-side,
+cache_service_impl.cc:48-65,81-123); TryGetEntry reads L1 then L2 and
+promotes L2 hits (:125-148); PutEntry is servant-token-gated and writes
+L1 + L2 + the Bloom filter (:150-170); a 60s timer rebuilds the filter
+from the engine's key enumeration (:172-180).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .. import api
+from ..common import compress
+from ..common.token_verifier import TokenVerifier
+from ..rpc import RpcContext, RpcError, ServiceSpec
+from ..utils.clock import REAL_CLOCK, Clock
+from ..utils.logging import get_logger
+from .bloom_filter_generator import BloomFilterGenerator
+from .cache_engine import CacheEngine
+from .in_memory_cache import InMemoryCache
+
+logger = get_logger("cache.service")
+
+SERVICE_NAME = "ytpu.CacheService"
+
+# Sync ages beyond this force a full filter fetch even if the deque
+# could technically serve the gap (staleness bound).
+_MAX_INCREMENTAL_AGE_S = 1800.0
+# Compensation margin added to the client-reported age so clock skew and
+# RPC latency can't open a sync hole.
+_INCREMENTAL_COMPENSATION_S = 10.0
+_MAX_ENTRY_BYTES = 128 << 20  # reference cache packet cap (entry.cc:27-28)
+
+
+class CacheService:
+    def __init__(
+        self,
+        l1: InMemoryCache,
+        l2: CacheEngine,
+        *,
+        user_tokens: TokenVerifier = TokenVerifier(),
+        servant_tokens: TokenVerifier = TokenVerifier(),
+        clock: Clock = REAL_CLOCK,
+    ):
+        self.l1 = l1
+        self.l2 = l2
+        self.bloom = BloomFilterGenerator(clock=clock)
+        self._user_tokens = user_tokens
+        self._servant_tokens = servant_tokens
+        self._l2_hits = 0
+        self._fills = 0
+        self._lock = threading.Lock()
+        # Initial rebuild so restarts serve a filter that matches L2.
+        self.rebuild_bloom_filter()
+
+    # -- wiring ------------------------------------------------------------
+
+    def spec(self) -> ServiceSpec:
+        s = ServiceSpec(SERVICE_NAME)
+        s.add("FetchBloomFilter", api.cache.FetchBloomFilterRequest,
+              self.FetchBloomFilter)
+        s.add("TryGetEntry", api.cache.TryGetEntryRequest, self.TryGetEntry)
+        s.add("PutEntry", api.cache.PutEntryRequest, self.PutEntry)
+        return s
+
+    def rebuild_bloom_filter(self) -> None:
+        """60s-cadence timer body (and startup)."""
+        keys = set(self.l2.keys()) | set(self.l1.keys())
+        self.bloom.rebuild(keys)
+
+    # -- handlers ----------------------------------------------------------
+
+    def FetchBloomFilter(self, req, attachment, ctx: RpcContext):
+        if not self._user_tokens.verify(req.token):
+            raise RpcError(api.cache.CACHE_STATUS_ACCESS_DENIED, "bad token")
+        resp = api.cache.FetchBloomFilterResponse()
+        age = req.seconds_since_last_fetch
+        can_incremental = (
+            req.seconds_since_last_full_fetch > 0
+            and age > 0
+            and age <= _MAX_INCREMENTAL_AGE_S
+            and self.bloom.can_serve_incremental(
+                age + _INCREMENTAL_COMPENSATION_S)
+        )
+        if can_incremental:
+            resp.incremental = True
+            resp.newly_populated_keys.extend(
+                self.bloom.get_newly_populated_keys(
+                    age + _INCREMENTAL_COMPENSATION_S))
+            return resp
+        resp.incremental = False
+        resp.num_hashes = self.bloom.num_hashes
+        # Attachment = zstd(u32 salt + filter words): the salt travels
+        # with the filter so replicas always probe with the right layout.
+        ctx.response_attachment = compress.compress(
+            self.bloom.salt.to_bytes(4, "little")
+            + self.bloom.filter_bytes())
+        return resp
+
+    def TryGetEntry(self, req, attachment, ctx: RpcContext):
+        if not self._user_tokens.verify(req.token):
+            raise RpcError(api.cache.CACHE_STATUS_ACCESS_DENIED, "bad token")
+        if not req.key:
+            raise RpcError(api.cache.CACHE_STATUS_INVALID_ARGUMENT, "no key")
+        value = self.l1.try_get(req.key)
+        if value is None:
+            value = self.l2.try_get(req.key)
+            if value is not None:
+                # Promote: an L2 hit is evidence of reuse.
+                with self._lock:
+                    self._l2_hits += 1
+                self.l1.put(req.key, value)
+        if value is None:
+            raise RpcError(api.cache.CACHE_STATUS_NOT_FOUND, req.key)
+        ctx.response_attachment = value
+        return api.cache.TryGetEntryResponse()
+
+    def PutEntry(self, req, attachment, ctx: RpcContext):
+        # Only compile servants may fill the cache: a malicious *user*
+        # token must not be able to poison results served to everyone
+        # (reference cache_service_impl.cc:150-156).
+        if not self._servant_tokens.verify(req.token):
+            raise RpcError(api.cache.CACHE_STATUS_ACCESS_DENIED,
+                           "servant token required")
+        if not req.key or not attachment:
+            raise RpcError(api.cache.CACHE_STATUS_INVALID_ARGUMENT,
+                           "key and value required")
+        if len(attachment) > _MAX_ENTRY_BYTES:
+            raise RpcError(api.cache.CACHE_STATUS_INVALID_ARGUMENT,
+                           "entry too large")
+        self.l1.put(req.key, attachment)
+        self.l2.put(req.key, attachment)
+        self.bloom.add(req.key)
+        with self._lock:
+            self._fills += 1
+        logger.info("cache fill: %s (%d bytes)", req.key, len(attachment))
+        return api.cache.PutEntryResponse()
+
+    # -- introspection -------------------------------------------------------
+
+    def inspect(self) -> dict:
+        return {
+            "l1": self.l1.stats(),
+            "l2": {"engine": self.l2.name, **self.l2.stats()},
+            "l2_hits": self._l2_hits,
+            "fills": self._fills,
+            "bloom_fill_ratio": round(self.bloom.fill_ratio(), 6),
+        }

@@ -1,0 +1,42 @@
+"""Client-side subprocess execution with streaming output sinks.
+
+Parity with reference yadcc/client/common/command.{h,cc}: run a program,
+stream its stdout chunk-by-chunk into a sink chain (the preprocess path
+tees into digest + zstd in one pass), pass stderr through, and support
+full passthrough exec for non-distributable invocations."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Sequence
+
+
+def execute_command(
+    argv: Sequence[str],
+    *,
+    sink=None,
+    env: Optional[Dict[str, str]] = None,
+    chunk_size: int = 256 * 1024,
+) -> int:
+    """Run argv; stdout streams into `sink.write` (or passes through),
+    stderr passes through.  Returns the exit code."""
+    proc = subprocess.Popen(
+        list(argv),
+        stdout=subprocess.PIPE if sink is not None else None,
+        env={**os.environ, **env} if env else None,
+    )
+    if sink is not None:
+        assert proc.stdout is not None
+        while True:
+            chunk = proc.stdout.read(chunk_size)
+            if not chunk:
+                break
+            sink.write(chunk)
+    return proc.wait()
+
+
+def pass_through_to_program(argv: Sequence[str]) -> int:
+    """Exec-like passthrough (keeps our PID's exit code semantics)."""
+    return subprocess.call(list(argv))

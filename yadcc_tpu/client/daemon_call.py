@@ -1,0 +1,49 @@
+"""Minimal HTTP client to the local daemon.
+
+Parity with reference yadcc/client/common/daemon_call.{h,cc}: blocking
+loopback HTTP with an injectable handler seam so tests fake the daemon
+without sockets (the reference's SetDaemonCallGatheredHandler,
+daemon_call.h:46-52)."""
+
+from __future__ import annotations
+
+import http.client
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .env_options import daemon_port
+
+
+@dataclass
+class DaemonResponse:
+    status: int
+    body: bytes
+
+
+# Test seam: when set, calls go here instead of the network.
+_handler: Optional[Callable[[str, str, bytes], DaemonResponse]] = None
+
+
+def set_daemon_call_handler(
+    handler: Optional[Callable[[str, str, bytes], DaemonResponse]]
+) -> None:
+    global _handler
+    _handler = handler
+
+
+def call_daemon(method: str, path: str, body: bytes = b"",
+                timeout_s: float = 30.0) -> DaemonResponse:
+    """Returns status -1 on connection failure (daemon not running)."""
+    if _handler is not None:
+        return _handler(method, path, body)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", daemon_port(),
+                                          timeout=timeout_s)
+        conn.request(method, path, body=body or None,
+                     headers={"Content-Type": "application/octet-stream"})
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return DaemonResponse(resp.status, data)
+    except OSError:
+        return DaemonResponse(-1, b"")

@@ -1,0 +1,53 @@
+"""Client environment knobs.
+
+Parity with reference yadcc/client/common/env_options.{h,cc} and the
+semantics documented in yadcc/doc/client.md:15-25 / doc/client/cxx.md:
+the client must not depend on any flag library (startup latency), so all
+configuration is environment variables:
+
+    YTPU_CACHE_CONTROL     0 = off, 1 = read/write (default), 2 = verify
+    YTPU_LOG_LEVEL         DEBUG/INFO/WARNING/ERROR (default WARNING)
+    YTPU_DAEMON_PORT       local daemon port (default 8334)
+    YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD
+                           preprocessed sizes below this compile locally
+    YTPU_IGNORE_TIMESTAMP_MACROS
+                           1 = cache even with __TIME__ et al
+    YTPU_WARN_ON_WAIT      1 = warn when quota waits are slow
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def cache_control() -> int:
+    v = _int_env("YTPU_CACHE_CONTROL", 1)
+    return v if v in (0, 1, 2) else 1
+
+
+def log_level() -> str:
+    return os.environ.get("YTPU_LOG_LEVEL", "WARNING").upper()
+
+
+def daemon_port() -> int:
+    return _int_env("YTPU_DAEMON_PORT", 8334)
+
+
+def compile_on_cloud_size_threshold() -> int:
+    # Tiny TUs aren't worth a network round trip (reference default 8K).
+    return _int_env("YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD", 8192)
+
+
+def ignore_timestamp_macros() -> bool:
+    return _int_env("YTPU_IGNORE_TIMESTAMP_MACROS", 0) == 1
+
+
+def warn_on_wait() -> bool:
+    return _int_env("YTPU_WARN_ON_WAIT", 1) == 1

@@ -1,0 +1,94 @@
+"""Preprocessing driver: one pass produces compressed source + digest.
+
+Parity with reference yadcc/client/cxx/rewrite_file.cc:75-182: run
+`<compiler> -E -fdirectives-only -fno-working-directory` (directives-only
+preprocessing is ~4x faster and keeps macros unexpanded for better cache
+hits), streaming stdout simultaneously into a zstd compressor and the
+content digest; fall back silently to plain -E when the compiler rejects
+-fdirectives-only.  When the fakeroot preload library is available it is
+injected so compiler-install-dependent include paths in linemarkers
+become machine-independent (higher cache hit rates across hosts).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..common.compress import CompressingWriter, TeeWriter
+from ..common.hashing import DigestingWriter
+from . import logging as log
+from .command import execute_command
+from .compiler_args import CompilerArgs
+
+
+@dataclass
+class RewriteResult:
+    compressed_source: bytes
+    source_digest: str
+    uncompressed_size: int
+    directives_only: bool  # servant must compile with matching flags
+
+
+class _Collector:
+    def __init__(self):
+        self.chunks: List[bytes] = []
+
+    def write(self, data: bytes) -> int:
+        self.chunks.append(data)
+        return len(data)
+
+
+def _fakeroot_path() -> Optional[str]:
+    """The LD_PRELOAD shim (built from native/fakeroot.c); optional."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cand = os.path.join(here, "native", "libytpufakeroot.so")
+    return cand if os.path.exists(cand) else None
+
+
+def _run_preprocess(compiler: str, tail: List[str]) -> Optional[RewriteResult]:
+    collector = _Collector()
+    digester = DigestingWriter()
+    zw = CompressingWriter(collector)
+    sink = TeeWriter(digester, zw)
+    env = {}
+    preload = _fakeroot_path()
+    if preload:
+        env["LD_PRELOAD"] = preload
+        env["YTPU_INTERNAL_COMPILER_PATH"] = os.path.dirname(
+            os.path.dirname(os.path.realpath(compiler)))
+    rc = execute_command([compiler] + tail, sink=sink, env=env or None)
+    if rc != 0:
+        return None
+    zw.close()
+    return RewriteResult(
+        compressed_source=b"".join(collector.chunks),
+        source_digest=digester.hexdigest(),
+        uncompressed_size=digester.bytes_written,
+        directives_only=False,  # caller fills in
+    )
+
+
+def rewrite_file(args: CompilerArgs, compiler_path: str
+                 ) -> Optional[RewriteResult]:
+    """None when even plain -E fails (caller falls back to local
+    compilation, which will print the real diagnostics)."""
+    base = args.rewrite(
+        remove=["-c"],
+        remove_prefix=["-o"],
+        add=[],
+        keep_sources=True,
+    )
+    fast = ["-E", "-fdirectives-only", "-fno-working-directory"] + base
+    result = _run_preprocess(compiler_path, fast)
+    if result is not None:
+        result.directives_only = True
+        return result
+    log.info("-fdirectives-only failed; retrying with plain -E")
+    slow = ["-E", "-fno-working-directory"] + base
+    result = _run_preprocess(compiler_path, slow)
+    if result is not None:
+        result.directives_only = False
+    return result

@@ -1,0 +1,156 @@
+"""The compiler-masquerading client.
+
+Parity with reference yadcc/client/cxx/yadcc-cxx.cc: installed as a
+symlink named `g++`/`gcc`/`clang++` early in PATH (or invoked as
+`ytpu-cxx g++ ...`), it decides whether the invocation is distributable
+(:37-65), preprocesses locally (streaming into digest+zstd), short-
+circuits tiny TUs to local compilation, submits to the local daemon,
+long-polls for the result with a 5-attempt cloud retry ladder and local
+fallback when quota is free (:186-250), and finally writes the outputs
+exactly where the build system expects them.
+
+Exit codes: the remote compiler's own exit code passes through verbatim
+— callers (make/ninja) must not be able to tell the difference.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from . import logging as log
+from .command import pass_through_to_program
+from .compilation_saas import (
+    CloudError,
+    apply_path_patches,
+    submit_compilation_task,
+    wait_for_compilation_task,
+    write_compilation_results,
+)
+from .compiler_args import CompilerArgs, is_distributable
+from .env_options import cache_control, compile_on_cloud_size_threshold
+from .rewrite_file import rewrite_file
+from .task_quota import task_quota
+
+_CLOUD_RETRIES = 5
+_WRAPPER_MARKERS = ("ccache", "distcc", "icecc", "ytpu", "yadcc")
+
+
+def find_real_compiler(invoked_as: str) -> Optional[str]:
+    """Resolve the actual compiler on PATH, skipping ourselves and other
+    build accelerators (reference yadcc-cxx.cc:118-140)."""
+    name = os.path.basename(invoked_as)
+    me = os.path.realpath(sys.argv[0]) if sys.argv else ""
+    for d in os.environ.get("PATH", "").split(os.pathsep):
+        if not d:
+            continue
+        cand = os.path.join(d, name)
+        if not (os.path.isfile(cand) and os.access(cand, os.X_OK)):
+            continue
+        real = os.path.realpath(cand)
+        if real == me:
+            continue
+        lowered = real.lower()
+        if any(m in lowered for m in _WRAPPER_MARKERS):
+            continue
+        return cand
+    return None
+
+
+def _compile_locally(compiler: str, args: CompilerArgs) -> int:
+    with task_quota(lightweight=False):
+        return pass_through_to_program([compiler] + args.args)
+
+
+def entry(argv: List[str]) -> int:
+    """argv: [invoked-name, compiler-args...].  When invoked via the
+    `ytpu-cxx g++ ...` form, argv[0] is the real compiler name."""
+    args = CompilerArgs.parse(argv)
+    compiler = find_real_compiler(args.compiler)
+    if compiler is None:
+        log.error(f"cannot find real compiler for {args.compiler!r}")
+        return 127
+
+    ok, why = is_distributable(args)
+    if not ok:
+        log.debug(f"not distributable ({why}); running locally")
+        return _compile_locally(compiler, args)
+
+    # Preprocess under lightweight quota (reference rewrite_file.cc:122).
+    with task_quota(lightweight=True) as granted:
+        if not granted:
+            log.warning("local daemon unreachable; compiling locally")
+            return pass_through_to_program([compiler] + args.args)
+        rewritten = rewrite_file(args, compiler)
+    if rewritten is None:
+        # Preprocessing failed — recompile locally so the user sees the
+        # compiler's own diagnostics.
+        return _compile_locally(compiler, args)
+
+    if rewritten.uncompressed_size < compile_on_cloud_size_threshold():
+        log.debug("tiny TU; compiling locally")
+        return _compile_locally(compiler, args)
+
+    # Arguments forwarded to the servant: no -o (it picks its own), no
+    # dependency-generation or include paths (already resolved by
+    # preprocessing — reference compilation_saas.cc:57-64).
+    remote_args = args.rewrite(
+        remove=["-c", "-include", "-imacros", "-isystem", "-iquote", "-I"],
+        remove_prefix=["-o", "-M", "-I", "-iquote", "-isystem", "-include",
+                       "-Wp,"],
+        keep_sources=False,
+    )
+    if rewritten.directives_only:
+        remote_args += ["-fpreprocessed", "-fdirectives-only"]
+    invocation = " ".join(remote_args)
+
+    source = args.sources[0]
+    for attempt in range(_CLOUD_RETRIES):
+        try:
+            task_id = submit_compilation_task(
+                compiler_path=compiler,
+                source_path=source,
+                source_digest=rewritten.source_digest,
+                compressed_source=rewritten.compressed_source,
+                invocation_arguments=invocation,
+                cache_control=cache_control(),
+            )
+            result, patches = wait_for_compilation_task(task_id)
+        except CloudError as e:
+            log.warning(f"cloud attempt {attempt + 1} failed: {e}")
+            continue
+        if result.exit_code == 127:
+            # Servant-side environment trouble, not a compile error:
+            # retry elsewhere (reference yadcc-cxx.cc:214-222).
+            log.warning("servant could not run the compiler; retrying")
+            continue
+        if result.exit_code != 0:
+            # A genuine compile error: print diagnostics, pass it through.
+            sys.stderr.write(result.standard_error)
+            sys.stdout.write(result.standard_output)
+            return result.exit_code
+        patched = apply_path_patches(
+            result.files, patches,
+            client_dir=os.path.dirname(os.path.abspath(source)) or ".")
+        write_compilation_results(patched, args)
+        sys.stderr.write(result.standard_error)
+        sys.stdout.write(result.standard_output)
+        return 0
+
+    log.warning("cloud compilation failed repeatedly; falling back locally")
+    return _compile_locally(compiler, args)
+
+
+def main() -> None:
+    invoked = os.path.basename(sys.argv[0])
+    if invoked in ("yadcc_cxx.py", "ytpu-cxx", "__main__.py") \
+            and len(sys.argv) > 1:
+        argv = sys.argv[1:]
+    else:
+        argv = [invoked] + sys.argv[1:]
+    sys.exit(entry(argv))
+
+
+if __name__ == "__main__":
+    main()

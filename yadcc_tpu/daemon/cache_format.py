@@ -1,0 +1,96 @@
+"""Compilation cache-entry wire format.
+
+Parity with reference yadcc/daemon/cache_format.cc:35-127: an entry
+bundles the compiler's exit code, stdout/stderr, the produced output
+files (individually zstd-compressed) and their path-patch locations,
+with an integrity digest over the file payloads so a corrupted cache
+entry is detected instead of linking garbage into the user's build.
+
+Layout:  b"YTC1" + u32 meta_len + CacheMeta-JSON + multi_chunk(files)
+
+Cache keys are derived from the task digest (reference :56-64), i.e.
+compiler + args + preprocessed source.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..common.hashing import digest_bytes
+from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+from .task_digest import get_cxx_task_digest
+
+_MAGIC = b"YTC1"
+_LEN = struct.Struct("<I")
+
+# Bump the key prefix on any format change: old entries become silent
+# misses instead of parse failures (reference cache_format.cc:56-64).
+_KEY_PREFIX = "ytpu-cxx1-entry-"
+
+
+@dataclass
+class CacheEntry:
+    exit_code: int
+    standard_output: bytes
+    standard_error: bytes
+    # file key (extension like ".o") -> zstd-compressed content.
+    files: Dict[str, bytes]
+    # file key -> [(position, total_size, suffix_to_keep)].
+    patches: Dict[str, List[Tuple[int, int, bytes]]] = field(
+        default_factory=dict)
+
+
+def get_cache_key(compiler_digest: str, invocation_arguments: str,
+                  source_digest: str) -> str:
+    return _KEY_PREFIX + get_cxx_task_digest(
+        compiler_digest, invocation_arguments, source_digest)
+
+
+def write_cache_entry(entry: CacheEntry) -> bytes:
+    file_keys = sorted(entry.files)
+    chunks = [entry.files[k] for k in file_keys]
+    body = make_multi_chunk(chunks)
+    meta = {
+        "exit_code": entry.exit_code,
+        "stdout_hex": entry.standard_output.hex(),
+        "stderr_hex": entry.standard_error.hex(),
+        "file_keys": file_keys,
+        "patches": {
+            k: [[p, t, s.hex()] for p, t, s in v]
+            for k, v in entry.patches.items()
+        },
+        "files_digest": digest_bytes(body),
+    }
+    meta_bytes = json.dumps(meta).encode()
+    return _MAGIC + _LEN.pack(len(meta_bytes)) + meta_bytes + body
+
+
+def try_parse_cache_entry(data: bytes) -> Optional[CacheEntry]:
+    """None on any corruption — a bad entry must read as a miss."""
+    try:
+        if not data.startswith(_MAGIC):
+            return None
+        (meta_len,) = _LEN.unpack_from(data, 4)
+        meta_end = 8 + meta_len
+        meta = json.loads(data[8:meta_end])
+        body = data[meta_end:]
+        if meta["files_digest"] != digest_bytes(body):
+            return None  # integrity failure
+        chunks = try_parse_multi_chunk(body)
+        if chunks is None or len(chunks) != len(meta["file_keys"]):
+            return None
+        return CacheEntry(
+            exit_code=meta["exit_code"],
+            standard_output=bytes.fromhex(meta["stdout_hex"]),
+            standard_error=bytes.fromhex(meta["stderr_hex"]),
+            files=dict(zip(meta["file_keys"], chunks)),
+            patches={
+                k: [(p, t, bytes.fromhex(s)) for p, t, s in v]
+                for k, v in meta.get("patches", {}).items()
+            },
+        )
+    except Exception:
+        return None

@@ -1,0 +1,98 @@
+"""Compiler discovery and digesting.
+
+Parity with reference yadcc/daemon/cloud/compiler_registry.cc:44-166:
+scan PATH plus configured extra dirs every 60s for gcc/g++/clang/clang++
+binaries, skip build-accelerator wrappers (ccache/distcc/icecc/ytpu
+symlinks — executing one of those from a servant would recurse), digest
+each real binary, and serve digest -> path lookups for incoming tasks.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from ...common.hashing import digest_file
+from ...utils.logging import get_logger
+
+logger = get_logger("daemon.compiler_registry")
+
+_COMPILER_NAMES = ("gcc", "g++", "clang", "clang++", "cc", "c++")
+_WRAPPER_MARKERS = ("ccache", "distcc", "icecc", "ytpu", "yadcc")
+
+
+class CompilerRegistry:
+    def __init__(self, extra_dirs: Sequence[str] = ()):
+        self._extra_dirs = list(extra_dirs)
+        self._lock = threading.Lock()
+        self._by_digest: Dict[str, str] = {}
+        self._digest_memo: Dict[tuple, str] = {}  # (real, size, mtime)
+        self.rescan()
+
+    # -- queries -------------------------------------------------------------
+
+    def try_get_compiler_path(self, digest: str) -> Optional[str]:
+        with self._lock:
+            return self._by_digest.get(digest)
+
+    def environments(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_digest)
+
+    # -- scanning ------------------------------------------------------------
+
+    def rescan(self) -> None:
+        """60s-cadence timer body."""
+        dirs = os.environ.get("PATH", "").split(os.pathsep) + self._extra_dirs
+        found: Dict[str, str] = {}
+        for d in dirs:
+            if not d:
+                continue
+            for name in _COMPILER_NAMES:
+                p = Path(d) / name
+                real = self._resolve_usable(p)
+                if real is None:
+                    continue
+                try:
+                    st = os.stat(real)
+                    memo_key = (real, st.st_size, int(st.st_mtime))
+                    with self._lock:
+                        digest = self._digest_memo.get(memo_key)
+                    if digest is None:
+                        digest = digest_file(real)
+                        with self._lock:
+                            self._digest_memo[memo_key] = digest
+                except OSError:
+                    continue
+                found.setdefault(digest, str(p))
+        with self._lock:
+            added = set(found) - set(self._by_digest)
+            self._by_digest = found
+        for digest in added:
+            logger.info("registered compiler %s (%s)", found[digest],
+                        digest[:16])
+
+    @staticmethod
+    def _resolve_usable(p: Path) -> Optional[str]:
+        """Real path of a usable compiler binary; None for wrappers,
+        broken symlinks, and non-executables."""
+        try:
+            if not p.exists() or not os.access(p, os.X_OK):
+                return None
+            real = p.resolve(strict=True)
+        except OSError:
+            return None
+        lowered = str(real).lower()
+        if any(m in lowered for m in _WRAPPER_MARKERS):
+            return None
+        # A symlink chain passing through a wrapper name also disqualifies.
+        hop = p
+        for _ in range(16):
+            if any(m in hop.name.lower() for m in _WRAPPER_MARKERS):
+                return None
+            if not hop.is_symlink():
+                break
+            hop = hop.parent / os.readlink(hop)
+        return str(real)

@@ -1,0 +1,183 @@
+"""Servant-side C++ compilation task.
+
+Parity with reference yadcc/daemon/cloud/remote_task/cxx_compilation_task
+.{h,cc} and remote_task.{h,cc}:
+
+* Prepare (:151-194): decompress the attached preprocessed source,
+  digest it, scan for timestamp macros (__TIME__/__DATE__/__TIMESTAMP__)
+  that make results uncacheable unless -D-overridden (:46-76), create a
+  LENGTH-PADDED workspace directory and assemble the command line with
+  the servant's own output path.
+* Completion (:94-140 + remote_task.cc:47-88): collect produced files,
+  locate every occurrence of the padded workspace path embedded in them
+  (debug info, coverage notes) and report the byte regions as patch
+  locations so the *client* can splice in its real path — which is why
+  the workspace path is padded: any shorter client path fits in place.
+* On success, pack a cache entry and fill the distributed cache
+  asynchronously.
+
+The compile itself is `sh -c "<compiler> <args> -o <ws>/output.o <src>"`
+with no network or shared state — pure subprocess work.
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...common import compress
+from ...common.hashing import digest_bytes
+from ..cache_format import CacheEntry, get_cache_key, write_cache_entry
+from ..task_digest import get_cxx_task_digest
+from .execution_engine import TaskOutput
+from .temporary import TemporaryDir
+
+# The workspace path is padded to this length so any client path of sane
+# length can be patched over it (reference pads to PATH_MAX; 224 keeps
+# paths well under common 255-byte component limits while still covering
+# realistic client paths).
+_PADDED_WORKSPACE_LEN = 224
+
+_TIMESTAMP_MACROS = (b"__TIME__", b"__DATE__", b"__TIMESTAMP__")
+
+
+def scan_source_cacheability(source: bytes, invocation_arguments: str) -> bool:
+    """False if the preprocessed source expands timestamp macros the
+    command line doesn't override (-D__TIME__=... etc.)."""
+    overridden = set()
+    for arg in shlex.split(invocation_arguments):
+        if arg.startswith("-D"):
+            name = arg[2:].split("=", 1)[0]
+            overridden.add(name.encode())
+    return not any(
+        m in source and m not in overridden for m in _TIMESTAMP_MACROS
+    )
+
+
+def find_patch_locations(
+    data: bytes, needle: bytes
+) -> List[Tuple[int, int, bytes]]:
+    """All (position, total_size, suffix_to_keep) regions where `needle`
+    (the padded workspace path) is embedded in `data`.
+
+    A region runs from the needle's start to the NUL terminating the
+    embedded string (debug path strings are NUL-terminated); the suffix
+    is whatever followed the workspace path (e.g. b"/src.cc").  The
+    client overwrites the region with <client_dir> + suffix + NUL pad.
+    """
+    out = []
+    start = 0
+    while True:
+        pos = data.find(needle, start)
+        if pos < 0:
+            break
+        end = data.find(b"\x00", pos)
+        if end < 0:
+            end = len(data)
+        suffix = data[pos + len(needle) : end]
+        out.append((pos, end - pos, suffix))
+        start = pos + 1
+    return out
+
+
+@dataclass
+class CloudCxxCompilationTask:
+    compiler_path: str
+    compiler_digest: str
+    invocation_arguments: str
+    source_path: str          # client-side path, for diagnostics
+    temp_root: str
+    disallow_cache_fill: bool = False
+
+    source: bytes = b""
+    source_digest: str = ""
+    cacheable: bool = True
+    workspace: Optional[TemporaryDir] = None
+    cmdline: str = ""
+    _source_ext: str = field(default=".ii", init=False)
+
+    # -- prepare -------------------------------------------------------------
+
+    def prepare(self, compressed_source: bytes) -> None:
+        src = compress.try_decompress(compressed_source)
+        if src is None:
+            raise ValueError("source attachment is not valid zstd")
+        self.source = src
+        self.source_digest = digest_bytes(src)
+        self.cacheable = (not self.disallow_cache_fill) and \
+            scan_source_cacheability(src, self.invocation_arguments)
+
+        self.workspace = TemporaryDir(self.temp_root, "cxx_")
+        # Pad the workspace path by extending the directory name.
+        import os
+
+        pad_needed = _PADDED_WORKSPACE_LEN - len(self.workspace.path)
+        if pad_needed > 0:
+            padded = self.workspace.path + "p" * pad_needed
+            os.rename(self.workspace.path, padded)
+            self.workspace.path = padded
+
+        # The attachment is already-preprocessed source; tell the
+        # compiler so via -x …-cpp-output (when the client preprocessed
+        # with -fdirectives-only, it keeps "-fpreprocessed
+        # -fdirectives-only" in the forwarded arguments).
+        lowered = self.source_path.lower()
+        language = "c" if lowered.endswith(".c") else "c++"
+        self._source_ext = ".i" if language == "c" else ".ii"
+        src_file = f"{self.workspace.path}/src{self._source_ext}"
+        with open(src_file, "wb") as fp:
+            fp.write(src)
+        self.cmdline = (
+            f"{shlex.quote(self.compiler_path)} "
+            f"-x {language}-cpp-output "
+            f"{self.invocation_arguments} -c "
+            f"-o {shlex.quote(self.workspace.path + '/output.o')} "
+            f"{shlex.quote(src_file)}"
+        )
+
+    @property
+    def task_digest(self) -> str:
+        return get_cxx_task_digest(self.compiler_digest,
+                                   self.invocation_arguments,
+                                   self.source_digest)
+
+    @property
+    def cache_key(self) -> str:
+        return get_cache_key(self.compiler_digest,
+                             self.invocation_arguments,
+                             self.source_digest)
+
+    # -- completion ----------------------------------------------------------
+
+    def collect_outputs(self, output: TaskOutput) -> Tuple[
+        Dict[str, bytes],
+        Dict[str, List[Tuple[int, int, bytes]]],
+        Optional[bytes],
+    ]:
+        """(compressed files by extension, patch locations by extension,
+        serialized cache entry or None).  Cleans up the workspace."""
+        assert self.workspace is not None
+        files: Dict[str, bytes] = {}
+        patches: Dict[str, List[Tuple[int, int, bytes]]] = {}
+        needle = self.workspace.path.encode()
+        if output.exit_code == 0:
+            for rel, content in self.workspace.read_all_files().items():
+                if rel == f"src{self._source_ext}":
+                    continue  # the input, not a product
+                ext = "." + rel.split(".", 1)[1] if "." in rel else rel
+                locs = find_patch_locations(content, needle)
+                if locs:
+                    patches[ext] = locs
+                files[ext] = compress.compress(content)
+        entry_bytes = None
+        if output.exit_code == 0 and self.cacheable:
+            entry_bytes = write_cache_entry(CacheEntry(
+                exit_code=output.exit_code,
+                standard_output=output.standard_output,
+                standard_error=output.standard_error,
+                files=files,
+                patches=patches,
+            ))
+        self.workspace.remove()
+        return files, patches, entry_bytes

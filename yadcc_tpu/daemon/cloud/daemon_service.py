@@ -1,0 +1,308 @@
+"""Servant RPC service + heartbeat pacemaker.
+
+Parity with reference yadcc/daemon/cloud/daemon_service_impl.{h,cc}:
+the DaemonService RPC surface (QueueCxxCompilationTask / ReferenceTask /
+WaitForCompilationOutput / FreeTask, :61-186) and the 1-second heartbeat
+pacemaker (:50-59, :190-242) reporting version, location, priority,
+memory, capacity, nprocs, load, compiler environments and running task
+digests — and consuming the scheduler's expired-task kill list plus the
+rotating daemon-token window.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ... import api
+from ...rpc import Channel, RpcContext, RpcError, ServiceSpec
+from ...utils.logging import get_logger
+from ...version import VERSION_FOR_UPGRADE
+from ..config import DaemonConfig
+from ..packing import pack_keyed_buffers
+from ..sysinfo import (
+    LoadAverageSampler,
+    read_memory_available,
+    read_memory_total,
+)
+from .compiler_registry import CompilerRegistry
+from .cxx_task import CloudCxxCompilationTask
+from .distributed_cache_writer import DistributedCacheWriter
+from .execution_engine import (
+    ExecutionEngine,
+    decide_capacity,
+)
+
+logger = get_logger("daemon.cloud.service")
+
+SERVICE_NAME = "ytpu.DaemonService"
+
+
+@dataclass
+class _TaskResult:
+    exit_code: int = 0
+    standard_output: bytes = b""
+    standard_error: bytes = b""
+    files: Dict[str, bytes] = field(default_factory=dict)
+    patches: Dict[str, list] = field(default_factory=dict)
+    failed_to_start: bool = False
+
+
+class DaemonService:
+    """The servant role of the daemon process."""
+
+    def __init__(
+        self,
+        config: DaemonConfig,
+        *,
+        engine: ExecutionEngine,
+        registry: CompilerRegistry,
+        cache_writer: Optional[DistributedCacheWriter] = None,
+        sampler: Optional[LoadAverageSampler] = None,
+        allow_poor_machine: bool = True,
+        cgroup_present: Optional[bool] = None,
+    ):
+        self.config = config
+        self.engine = engine
+        self.registry = registry
+        self.cache_writer = cache_writer
+        self.sampler = sampler or LoadAverageSampler()
+        self._allow_poor = allow_poor_machine
+        self._cgroup = cgroup_present
+        self._lock = threading.Lock()
+        # Tokens delegates may present, as rolled out by the scheduler.
+        self._acceptable_tokens: Set[str] = set()
+        self._results: Dict[int, _TaskResult] = {}
+        self._beat_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._sched_channel: Optional[Channel] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def spec(self) -> ServiceSpec:
+        s = ServiceSpec(SERVICE_NAME)
+        s.add("QueueCxxCompilationTask",
+              api.daemon.QueueCxxCompilationTaskRequest,
+              self.QueueCxxCompilationTask)
+        s.add("ReferenceTask", api.daemon.ReferenceTaskRequest,
+              self.ReferenceTask)
+        s.add("WaitForCompilationOutput",
+              api.daemon.WaitForCompilationOutputRequest,
+              self.WaitForCompilationOutput)
+        s.add("FreeTask", api.daemon.FreeDaemonTaskRequest, self.FreeTask)
+        return s
+
+    def _verify(self, token: str) -> None:
+        # Fail CLOSED: until the first heartbeat response delivers the
+        # scheduler's rotating token window, this servant serves nobody.
+        # An empty set must not accept-all — QueueCxxCompilationTask
+        # ultimately runs caller-supplied command lines.
+        with self._lock:
+            ok = bool(self._acceptable_tokens) and \
+                token in self._acceptable_tokens
+        if not ok:
+            raise RpcError(api.daemon.DAEMON_STATUS_ACCESS_DENIED,
+                           "unrecognized daemon token")
+
+    def set_acceptable_tokens_for_testing(self, tokens) -> None:
+        with self._lock:
+            self._acceptable_tokens = set(tokens)
+
+    # -- RPC handlers -------------------------------------------------------
+
+    def QueueCxxCompilationTask(self, req, attachment: bytes,
+                                ctx: RpcContext):
+        self._verify(req.token)
+        if req.compression_algorithm != \
+                api.daemon.COMPRESSION_ALGORITHM_ZSTD:
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                           "only zstd sources accepted")
+        compiler = self.registry.try_get_compiler_path(
+            req.env_desc.compiler_digest)
+        if compiler is None:
+            raise RpcError(
+                api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE,
+                req.env_desc.compiler_digest)
+        task = CloudCxxCompilationTask(
+            compiler_path=compiler,
+            compiler_digest=req.env_desc.compiler_digest,
+            invocation_arguments=req.invocation_arguments,
+            source_path=req.source_path,
+            temp_root=self.config.temporary_dir,
+            disallow_cache_fill=req.disallow_cache_fill,
+        )
+        try:
+            task.prepare(attachment)
+        except ValueError as e:
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT, str(e))
+
+        # Defensive dedup: an identical task already running here can
+        # simply be joined (the delegate-side dedup usually catches this
+        # first via ReferenceTask).
+        existing = self.engine.find_task_by_digest(task.task_digest)
+        if existing is not None and self.engine.reference_task(existing):
+            task.workspace.remove()
+            return api.daemon.QueueCxxCompilationTaskResponse(
+                task_id=existing)
+
+        def on_completion(task_id: int, output):
+            files, patches, cache_entry = task.collect_outputs(output)
+            result = _TaskResult(
+                exit_code=output.exit_code,
+                standard_output=output.standard_output,
+                standard_error=output.standard_error,
+                files=files,
+                patches=patches,
+            )
+            with self._lock:
+                self._results[task_id] = result
+            if cache_entry is not None and self.cache_writer is not None:
+                self.cache_writer.async_write(task.cache_key, cache_entry)
+
+        task_id = self.engine.try_queue_task(
+            grant_id=req.task_grant_id,
+            digest=task.task_digest,
+            cmdline=task.cmdline,
+            on_completion=on_completion,
+        )
+        if task_id is None:
+            task.workspace.remove()
+            raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
+                           "servant saturated")
+        return api.daemon.QueueCxxCompilationTaskResponse(task_id=task_id)
+
+    def ReferenceTask(self, req, attachment, ctx):
+        self._verify(req.token)
+        if not self.engine.reference_task(req.task_id):
+            raise RpcError(api.daemon.DAEMON_STATUS_TASK_NOT_FOUND,
+                           str(req.task_id))
+        return api.daemon.ReferenceTaskResponse()
+
+    def WaitForCompilationOutput(self, req, attachment, ctx: RpcContext):
+        self._verify(req.token)
+        if api.daemon.COMPRESSION_ALGORITHM_ZSTD not in list(
+                req.acceptable_compression_algorithms or
+                [api.daemon.COMPRESSION_ALGORITHM_ZSTD]):
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                           "peer cannot accept zstd")
+        resp = api.daemon.WaitForCompilationOutputResponse()
+        if not self.engine.is_known(req.task_id):
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+            return resp
+        output = self.engine.wait_for_task(
+            req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
+        if output is None:
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_RUNNING
+            return resp
+        with self._lock:
+            result = self._results.get(req.task_id)
+        if result is None:
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_FAILED
+            return resp
+        resp.status = api.daemon.COMPILATION_TASK_STATUS_DONE
+        resp.exit_code = result.exit_code
+        resp.standard_output = result.standard_output
+        resp.standard_error = result.standard_error
+        resp.compression_algorithm = api.daemon.COMPRESSION_ALGORITHM_ZSTD
+        for ext, locs in result.patches.items():
+            pl = resp.cxx_info.patches.add(file_key=ext)
+            for pos, total, suffix in locs:
+                pl.locations.add(position=pos, total_size=total,
+                                 suffix_to_keep=suffix)
+        ctx.response_attachment = pack_keyed_buffers(result.files)
+        return resp
+
+    def FreeTask(self, req, attachment, ctx):
+        self._verify(req.token)
+        self.engine.free_task(req.task_id)
+        with self._lock:
+            self._results.pop(req.task_id, None)
+        return api.daemon.FreeDaemonTaskResponse()
+
+    # -- heartbeat pacemaker -------------------------------------------------
+
+    def start_heartbeat(self) -> None:
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="heartbeat", daemon=True)
+        self._beat_thread.start()
+
+    def stop_heartbeat(self, graceful_leave: bool = True) -> None:
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=3)
+        if graceful_leave:
+            try:
+                self.heartbeat_once(leaving=True)
+            except RpcError:
+                pass
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(timeout=1.0):
+            self.sampler.sample()
+            try:
+                self.heartbeat_once()
+            except RpcError as e:
+                logger.warning("heartbeat failed: %s", e)
+
+    def _scheduler(self) -> Channel:
+        if self._sched_channel is None:
+            self._sched_channel = Channel(self.config.scheduler_uri)
+        return self._sched_channel
+
+    def heartbeat_once(self, leaving: bool = False) -> None:
+        dedicated = self.config.servant_priority_dedicated
+        capacity, reason = decide_capacity(
+            self.sampler.nprocs, dedicated,
+            allow_poor_machine=self._allow_poor,
+            cgroup_present=self._cgroup,
+        )
+        if self.config.max_remote_tasks:
+            capacity = min(capacity, self.config.max_remote_tasks) \
+                if capacity else 0
+        req = api.scheduler.HeartbeatRequest(
+            token=self.config.token,
+            next_heartbeat_in_ms=0 if leaving else 1000,
+            version=VERSION_FOR_UPGRADE,
+            location=self.config.location,
+            num_processors=self.sampler.nprocs,
+            current_load=self.sampler.loadavg(15),
+            priority=(api.scheduler.SERVANT_PRIORITY_DEDICATED if dedicated
+                      else api.scheduler.SERVANT_PRIORITY_USER),
+            not_accepting_task_reason=reason,
+            capacity=capacity if reason == 0 else 0,
+            total_memory_in_bytes=read_memory_total(),
+            memory_available_in_bytes=read_memory_available(),
+        )
+        for digest in self.registry.environments():
+            req.env_descs.add(compiler_digest=digest)
+        for tid, grant_id, digest in self.engine.running_tasks():
+            req.running_tasks.add(
+                servant_task_id=tid, task_grant_id=grant_id,
+                servant_location=self.config.location, task_digest=digest)
+        resp, _ = self._scheduler().call(
+            "ytpu.SchedulerService", "Heartbeat", req,
+            api.scheduler.HeartbeatResponse, timeout=5.0)
+        if leaving:
+            return
+        with self._lock:
+            if resp.acceptable_tokens:
+                self._acceptable_tokens = set(resp.acceptable_tokens)
+        if resp.expired_tasks:
+            self.engine.kill_expired_tasks(list(resp.expired_tasks))
+        self.engine.gc_completed_tasks()
+        # Results must not outlive their engine-side task (the delegate
+        # may never call FreeTask — crash, join path, GC race).
+        with self._lock:
+            self._results = {tid: r for tid, r in self._results.items()
+                             if self.engine.is_known(tid)}
+
+    # -- introspection -------------------------------------------------------
+
+    def inspect(self) -> dict:
+        return {
+            "engine": self.engine.inspect(),
+            "compilers": self.registry.environments(),
+            "load_15s": self.sampler.loadavg(15),
+        }

@@ -1,0 +1,50 @@
+"""Compiler subprocess launcher.
+
+Parity with reference yadcc/daemon/cloud/execute_command.cc:34-84: each
+task runs `sh -c <cmdline>` in its own process group (so a runaway
+compiler's children die with it), niced to 5 (foreign compiles must not
+starve the machine's owner), with stdin closed and stdout/stderr
+captured.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+
+def start_program(
+    cmdline: str,
+    *,
+    nice_level: int = 5,
+    cwd: str = "/",
+    env: Optional[dict] = None,
+) -> subprocess.Popen:
+    """Launch detached into its own process group; caller owns wait()."""
+
+    def pre_exec():  # runs in the child between fork and exec
+        os.setpgid(0, 0)
+        try:
+            os.nice(nice_level)
+        except OSError:
+            pass
+
+    return subprocess.Popen(
+        ["/bin/sh", "-c", cmdline],
+        cwd=cwd,
+        env=env,
+        stdin=subprocess.DEVNULL,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        preexec_fn=pre_exec,
+        start_new_session=False,
+    )
+
+
+def kill_process_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the whole group (reference execution_engine.cc:329-343)."""
+    try:
+        os.killpg(proc.pid, 9)
+    except (ProcessLookupError, PermissionError):
+        pass

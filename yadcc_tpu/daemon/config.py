@@ -1,0 +1,33 @@
+"""Daemon-wide configuration.
+
+Parity with reference yadcc/daemon/common_flags.{h,cc}: the scheduler
+URI (deliberately ONE host — the reference scopes out scheduler HA,
+common_flags.cc:19-28, and so do we), the cache-server URI, the access
+token, and the protocol version ledger (see yadcc_tpu/version.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .temp_dir import default_temp_root
+
+
+@dataclass
+class DaemonConfig:
+    scheduler_uri: str = "grpc://127.0.0.1:8336"
+    cache_server_uri: str = ""  # empty: cache disabled
+    token: str = ""
+
+    # Servant side.
+    serving_port: int = 8335
+    location: str = ""  # ip:port advertised to the scheduler
+    servant_priority_dedicated: bool = False
+    max_remote_tasks: int = 0  # 0: derive from capacity policy
+
+    # Delegate side.
+    local_port: int = 8334
+
+    temporary_dir: str = field(default_factory=default_temp_root)
+    inspect_port: int = 9335
+    inspect_credential: str = ""

@@ -1,0 +1,190 @@
+"""Daemon main: one process, two independent roles.
+
+Parity with reference yadcc/daemon/entry.cc:69-262: a *delegate* serving
+local clients over loopback HTTP (:8334) and a *servant* serving peer
+daemons over RPC (:8335) — either can be disabled; environment scrubbing
+(LC_ALL, GCC_COMPARE_DEBUG, SOURCE_DATE_EPOCH would make outputs differ
+across machines and poison the cache); privilege drop; stale temp
+cleanup; ordered shutdown.  Run:
+
+    python -m yadcc_tpu.daemon.entry \
+        --scheduler-uri grpc://scheduler:8336 \
+        --cache-server-uri grpc://cache:8337
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import threading
+import time
+
+from ..rpc import GrpcServer
+from ..utils import exposed_vars
+from ..utils.inspect_server import InspectServer
+from ..utils.logging import get_logger
+from .config import DaemonConfig
+from .privilege import drop_privileges
+from .sysinfo import LoadAverageSampler
+from .temp_dir import clean_stale_temp_dirs
+from .cloud.compiler_registry import CompilerRegistry
+from .cloud.daemon_service import DaemonService
+from .cloud.distributed_cache_writer import DistributedCacheWriter
+from .cloud.execution_engine import ExecutionEngine, decide_capacity
+from .local.config_keeper import ConfigKeeper
+from .local.distributed_cache_reader import DistributedCacheReader
+from .local.distributed_task_dispatcher import DistributedTaskDispatcher
+from .local.file_digest_cache import FileDigestCache
+from .local.http_service import LocalHttpService
+from .local.local_task_monitor import LocalTaskMonitor
+from .local.running_task_keeper import RunningTaskKeeper
+from .local.task_grant_keeper import TaskGrantKeeper
+
+logger = get_logger("daemon.entry")
+
+# Vars that make compiler output machine-dependent (reference entry.cc
+# env scrub): clear before any compile subprocess inherits them.
+_SCRUBBED_ENV = ("LC_ALL", "GCC_COMPARE_DEBUG", "SOURCE_DATE_EPOCH")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("yadcc-tpu-daemon")
+    p.add_argument("--scheduler-uri", default="grpc://127.0.0.1:8336")
+    p.add_argument("--cache-server-uri", default="")
+    p.add_argument("--token", default="")
+    p.add_argument("--local-port", type=int, default=8334)
+    p.add_argument("--serving-port", type=int, default=8335)
+    p.add_argument("--inspect-port", type=int, default=9335)
+    p.add_argument("--inspect-credential", default="")
+    p.add_argument("--location", default="",
+                   help="ip:port advertised to the scheduler")
+    p.add_argument("--dedicated", action="store_true")
+    p.add_argument("--max-remote-tasks", type=int, default=0)
+    p.add_argument("--extra-compiler-dirs", default="")
+    p.add_argument("--temporary-dir", default="")
+    p.add_argument("--allow-poor-machine", action="store_true",
+                   help="serve even with <=16 cores (small test rigs)")
+    p.add_argument("--ignore-cgroup-limits", action="store_true",
+                   help="serve even inside a cgroup/container; only safe "
+                        "when the container really owns its cores")
+    p.add_argument("--no-privilege-drop", action="store_true")
+    return p
+
+
+def _guess_local_ip(scheduler_uri: str) -> str:
+    target = scheduler_uri.split("://")[-1]
+    host, _, port = target.rpartition(":")
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((host or "8.8.8.8", int(port or 443)))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def daemon_start(args) -> None:
+    for var in _SCRUBBED_ENV:
+        os.environ.pop(var, None)
+    if not args.no_privilege_drop:
+        drop_privileges()
+
+    config = DaemonConfig(
+        scheduler_uri=args.scheduler_uri,
+        cache_server_uri=args.cache_server_uri,
+        token=args.token,
+        serving_port=args.serving_port,
+        local_port=args.local_port,
+        servant_priority_dedicated=args.dedicated,
+        max_remote_tasks=args.max_remote_tasks,
+    )
+    if args.temporary_dir:
+        config.temporary_dir = args.temporary_dir
+    removed = clean_stale_temp_dirs(config.temporary_dir)
+    if removed:
+        logger.info("removed %d stale temp dirs", removed)
+
+    # ---- servant role ----
+    sampler = LoadAverageSampler()
+    cgroup_present = False if args.ignore_cgroup_limits else None
+    capacity, _ = decide_capacity(sampler.nprocs, args.dedicated,
+                                  allow_poor_machine=args.allow_poor_machine,
+                                  cgroup_present=cgroup_present)
+    registry = CompilerRegistry(
+        [d for d in args.extra_compiler_dirs.split(",") if d])
+    engine = ExecutionEngine(max_concurrency=max(capacity, 1))
+    servant_server = GrpcServer(f"0.0.0.0:{args.serving_port}")
+    config.location = args.location or \
+        f"{_guess_local_ip(args.scheduler_uri)}:{servant_server.port}"
+    config_keeper = ConfigKeeper(args.scheduler_uri, args.token)
+    cache_writer = DistributedCacheWriter(
+        args.cache_server_uri, config_keeper.serving_daemon_token)
+    service = DaemonService(
+        config, engine=engine, registry=registry, cache_writer=cache_writer,
+        sampler=sampler, allow_poor_machine=args.allow_poor_machine,
+        cgroup_present=cgroup_present)
+    servant_server.add_service(service.spec())
+    servant_server.start()
+
+    # ---- delegate role ----
+    grant_keeper = TaskGrantKeeper(args.scheduler_uri, args.token)
+    cache_reader = DistributedCacheReader(args.cache_server_uri, args.token)
+    running_keeper = RunningTaskKeeper(args.scheduler_uri)
+    dispatcher = DistributedTaskDispatcher(
+        grant_keeper=grant_keeper,
+        config_keeper=config_keeper,
+        cache_reader=cache_reader,
+        running_task_keeper=running_keeper,
+    )
+    monitor = LocalTaskMonitor()
+    digest_cache = FileDigestCache()
+    stop = threading.Event()
+    http = LocalHttpService(
+        monitor=monitor, digest_cache=digest_cache, dispatcher=dispatcher,
+        on_leave=stop.set, port=args.local_port)
+
+    config_keeper.start()
+    cache_reader.start()
+    running_keeper.start()
+    service.start_heartbeat()
+    http.start()
+    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect.start()
+    exposed_vars.expose("yadcc/daemon/engine", engine.inspect)
+    exposed_vars.expose("yadcc/daemon/dispatcher", dispatcher.inspect)
+    exposed_vars.expose("yadcc/daemon/monitor", monitor.inspect)
+    exposed_vars.expose("yadcc/daemon/cache_reader", cache_reader.inspect)
+    logger.info("daemon up: local HTTP :%d, servant RPC :%d (as %s), "
+                "inspect :%d", http.port, servant_server.port,
+                config.location, inspect.port)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    last_rescan = time.monotonic()
+    while not stop.is_set():
+        time.sleep(1.0)
+        dispatcher.on_timer()
+        monitor.on_reclaim_timer()
+        if time.monotonic() - last_rescan >= 60.0:
+            registry.rescan()
+            last_rescan = time.monotonic()
+
+    logger.info("shutting down")
+    service.stop_heartbeat(graceful_leave=True)
+    http.stop()
+    servant_server.stop()
+    inspect.stop()
+    for c in (config_keeper, cache_reader, running_keeper, grant_keeper):
+        c.stop()
+    engine.stop()
+
+
+def main() -> None:
+    daemon_start(build_arg_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Delegate-side distributed cache reader with a local Bloom replica.
+
+Parity with reference yadcc/daemon/local/distributed_cache_reader.h:32-56:
+the daemon keeps a replica of the cache server's Bloom filter, synced
+incrementally (new keys) with a jittered ~10-minute full refetch, and
+TryRead() short-circuits guaranteed misses locally so cold builds don't
+pay a network round trip per TU.
+
+TPU path: when a batch of keys needs testing at once (burst submits,
+the benchmark sweep), the replica's word array is probed on-device via
+ops/bloom_probe.py — see batch_may_contain().
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+from ... import api
+from ...common import bloom, compress
+from ...rpc import Channel, RpcError
+from ...utils.logging import get_logger
+
+logger = get_logger("daemon.cache_reader")
+
+_FULL_FETCH_INTERVAL_S = 600.0  # ~10min, jittered per client
+_SYNC_INTERVAL_S = 10.0
+
+
+class DistributedCacheReader:
+    def __init__(self, cache_server_uri: str, token: str):
+        self._uri = cache_server_uri
+        self._token = token
+        self._salt = 0  # learned from each full fetch (rides the payload)
+        self._lock = threading.Lock()
+        self._filter: Optional[bloom.SaltedBloomFilter] = None
+        self._last_full_fetch = 0.0
+        self._last_fetch = 0.0
+        self._full_interval = _FULL_FETCH_INTERVAL_S * random.uniform(0.9, 1.1)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._channel: Optional[Channel] = None
+        self.hits = 0
+        self.bloom_rejects = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._uri)
+
+    def start(self) -> None:
+        if not self.enabled:
+            return
+        self.sync_once()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="bloom-sync", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- reads ---------------------------------------------------------------
+
+    def try_read(self, key: str) -> Optional[bytes]:
+        """None on miss (including Bloom-filtered definite misses)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            flt = self._filter
+        if flt is not None and not flt.may_contain(key):
+            self.bloom_rejects += 1
+            return None
+        try:
+            _, value = self._chan().call(
+                "ytpu.CacheService", "TryGetEntry",
+                api.cache.TryGetEntryRequest(token=self._token, key=key),
+                api.cache.TryGetEntryResponse, timeout=5.0)
+            self.hits += 1
+            return value
+        except RpcError:
+            self.misses += 1
+            return None
+
+    def batch_may_contain(self, keys: List[str]):
+        """Device-side batch Bloom test; numpy bool array (all-True when
+        no filter is synced yet — absence of evidence isn't a miss)."""
+        import numpy as np
+
+        with self._lock:
+            flt = self._filter
+        if flt is None or not keys:
+            return np.ones(len(keys), bool)
+        import jax.numpy as jnp
+
+        from ...ops.bloom_probe import bloom_may_contain
+
+        fps = bloom.key_fingerprints(keys, self._salt)
+        return np.asarray(bloom_may_contain(
+            jnp.asarray(flt.words), jnp.asarray(fps),
+            num_bits=flt.num_bits, num_hashes=flt.num_hashes))
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync_once(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            since_full = (now - self._last_full_fetch
+                          if self._last_full_fetch else 0)
+            since_any = now - self._last_fetch if self._last_fetch else 0
+            force_full = (self._filter is None
+                          or since_full >= self._full_interval)
+        req = api.cache.FetchBloomFilterRequest(
+            token=self._token,
+            seconds_since_last_full_fetch=0 if force_full
+            else int(since_full),
+            seconds_since_last_fetch=0 if force_full else int(since_any),
+        )
+        try:
+            resp, att = self._chan().call(
+                "ytpu.CacheService", "FetchBloomFilter", req,
+                api.cache.FetchBloomFilterResponse, timeout=10.0)
+        except RpcError as e:
+            logger.warning("bloom sync failed: %s", e)
+            return
+        with self._lock:
+            self._last_fetch = now
+            if resp.incremental:
+                if self._filter is not None:
+                    for key in resp.newly_populated_keys:
+                        self._filter.add(key)
+            else:
+                data = compress.try_decompress(att)
+                if data is not None and len(data) > 4:
+                    self._salt = int.from_bytes(data[:4], "little")
+                    self._filter = bloom.SaltedBloomFilter.from_bytes(
+                        data[4:], resp.num_hashes, self._salt)
+                    self._last_full_fetch = now
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=_SYNC_INTERVAL_S):
+            self.sync_once()
+
+    def _chan(self) -> Channel:
+        with self._lock:
+            if self._channel is None:
+                self._channel = Channel(self._uri)
+            return self._channel
+
+    def inspect(self) -> dict:
+        with self._lock:
+            synced = self._filter is not None
+        return {"synced": synced, "hits": self.hits,
+                "bloom_rejects": self.bloom_rejects, "misses": self.misses}

@@ -1,0 +1,56 @@
+"""Language-extensible distributed-task SPI (delegate side).
+
+Parity with reference yadcc/daemon/local/distributed_task.h: the
+dispatcher state machine is language-agnostic; a task type supplies its
+cache key, dedup digest, how to start itself on a chosen servant, and
+how to digest the servant's completion into a client-facing result.
+(The reference's internal versions also shipped Java/Scala tasks over
+this same seam — common_flags.cc version ledger.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class TaskResult:
+    exit_code: int = -1
+    standard_output: bytes = b""
+    standard_error: bytes = b""
+    # file key (extension) -> zstd-compressed bytes.
+    files: Dict[str, bytes] = field(default_factory=dict)
+    patches: Dict[str, List[Tuple[int, int, bytes]]] = field(
+        default_factory=dict)
+    # Provenance counters (reference distributed_task_dispatcher.h:222-224).
+    from_cache: bool = False
+    reused_existing: bool = False
+
+
+class DistributedTask:
+    """SPI; implementations: CxxCompilationTask (more languages later).
+
+    Implementations must expose `requestor_pid` (0 = unknown) for the
+    dispatcher's orphan-kill timer."""
+
+    def get_cache_key(self) -> Optional[str]:
+        """None when this task must bypass the cache."""
+        raise NotImplementedError
+
+    def get_digest(self) -> str:
+        """Cluster-wide dedup digest."""
+        raise NotImplementedError
+
+    def get_env_digest(self) -> str:
+        raise NotImplementedError
+
+    def start_task(self, channel, token: str, grant_id: int) -> int:
+        """Issue Queue*Task on the servant; returns the servant task id."""
+        raise NotImplementedError
+
+    def parse_servant_output(self, resp, attachment: bytes) -> TaskResult:
+        raise NotImplementedError
+
+    def parse_cache_entry(self, data: bytes) -> Optional[TaskResult]:
+        raise NotImplementedError

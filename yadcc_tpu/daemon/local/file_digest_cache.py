@@ -1,0 +1,30 @@
+"""(path, size, mtime) -> digest memo.
+
+Parity with reference yadcc/daemon/local/file_digest_cache.h:29-70: the
+daemon may not have read permission on the client's compiler binary, so
+the *client* digests it and reports the result; the daemon memoizes it
+against the file's cheap identity attributes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class FileDigestCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._memo: Dict[Tuple[str, int, int], str] = {}
+
+    def set(self, path: str, size: int, mtime: int, digest: str) -> None:
+        with self._lock:
+            self._memo[(path, size, mtime)] = digest
+
+    def try_get(self, path: str, size: int, mtime: int) -> Optional[str]:
+        with self._lock:
+            return self._memo.get((path, size, mtime))
+
+    def inspect(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._memo)}

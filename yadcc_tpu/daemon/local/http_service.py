@@ -1,0 +1,191 @@
+"""Client-facing loopback HTTP API.
+
+Parity with reference yadcc/daemon/local/http_service_impl.{h,cc} and
+the wire format in yadcc/daemon/local/README.md: plain HTTP/1.1 on
+127.0.0.1, JSON message bodies (uint64 as strings, per proto3 JSON),
+multi-chunk framing when attachments are present.  Routes:
+
+    GET  /local/get_version
+    POST /local/ask_to_leave
+    POST /local/acquire_quota        (200 granted / 503 timeout)
+    POST /local/release_quota
+    POST /local/set_file_digest
+    POST /local/submit_cxx_task      (multi-chunk: json + zstd source;
+                                      400: report compiler digest first)
+    POST /local/wait_for_cxx_task    (503: still running, retry;
+                                      404: unknown task id)
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from google.protobuf import json_format
+
+from ... import api
+from ...common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+from ...utils.logging import get_logger
+from ...version import BUILT_AT, VERSION_FOR_UPGRADE
+from .cxx_task import NeedCompilerDigest, make_cxx_task
+from .distributed_task_dispatcher import DistributedTaskDispatcher
+from .file_digest_cache import FileDigestCache
+from .local_task_monitor import LocalTaskMonitor
+
+logger = get_logger("daemon.http")
+
+
+def _to_json(msg) -> bytes:
+    # Zero-valued fields (e.g. exit_code 0) must appear explicitly: the
+    # zero-dependency client reads them without proto schema knowledge.
+    return json_format.MessageToJson(
+        msg, preserving_proto_field_name=True,
+        always_print_fields_with_no_presence=True).encode()
+
+
+def _from_json(cls, data: bytes):
+    msg = cls()
+    json_format.Parse(data.decode(), msg, ignore_unknown_fields=True)
+    return msg
+
+
+class LocalHttpService:
+    def __init__(
+        self,
+        *,
+        monitor: LocalTaskMonitor,
+        digest_cache: FileDigestCache,
+        dispatcher: DistributedTaskDispatcher,
+        on_leave: Optional[Callable[[], None]] = None,
+        port: int = 8334,
+        host: str = "127.0.0.1",
+    ):
+        self.monitor = monitor
+        self.digest_cache = digest_cache
+        self.dispatcher = dispatcher
+        self.on_leave = on_leave or (lambda: None)
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: bytes = b"",
+                       content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/local/get_version":
+                    resp = api.local.GetVersionResponse(
+                        built_at=BUILT_AT,
+                        version_for_upgrade=VERSION_FOR_UPGRADE)
+                    self._reply(200, _to_json(resp))
+                else:
+                    self._reply(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                try:
+                    service._route_post(self, self.path, body)
+                except Exception:
+                    logger.exception("error handling %s", self.path)
+                    try:
+                        self._reply(500)
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="local-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route_post(self, handler, path: str, body: bytes) -> None:
+        if path == "/local/ask_to_leave":
+            handler._reply(200, _to_json(api.local.AskToLeaveResponse()))
+            self.on_leave()
+            return
+        if path == "/local/acquire_quota":
+            req = _from_json(api.local.AcquireQuotaRequest, body)
+            ok = self.monitor.wait_for_running_new_task_permission(
+                req.requestor_pid, req.lightweight_task,
+                req.milliseconds_to_wait / 1000.0)
+            handler._reply(200 if ok else 503,
+                           _to_json(api.local.AcquireQuotaResponse()))
+            return
+        if path == "/local/release_quota":
+            req = _from_json(api.local.ReleaseQuotaRequest, body)
+            self.monitor.drop_task_permission(req.requestor_pid)
+            handler._reply(200, _to_json(api.local.ReleaseQuotaResponse()))
+            return
+        if path == "/local/set_file_digest":
+            req = _from_json(api.local.SetFileDigestRequest, body)
+            self.digest_cache.set(req.file_desc.path, req.file_desc.size,
+                                  req.file_desc.timestamp, req.digest)
+            handler._reply(200, _to_json(api.local.SetFileDigestResponse()))
+            return
+        if path == "/local/submit_cxx_task":
+            chunks = try_parse_multi_chunk(body)
+            if not chunks or len(chunks) != 2:
+                handler._reply(400, b'{"error":"expect json+source chunks"}')
+                return
+            req = _from_json(api.local.SubmitCxxTaskRequest, chunks[0])
+            try:
+                task = make_cxx_task(req, chunks[1], self.digest_cache)
+            except NeedCompilerDigest:
+                handler._reply(
+                    400, b'{"error":"compiler digest unknown; '
+                         b'set_file_digest first"}')
+                return
+            task_id = self.dispatcher.queue_task(task)
+            handler._reply(200, _to_json(
+                api.local.SubmitCxxTaskResponse(task_id=task_id)))
+            return
+        if path == "/local/wait_for_cxx_task":
+            req = _from_json(api.local.WaitForCxxTaskRequest, body)
+            result = self.dispatcher.wait_for_task(
+                req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
+            if result is None:
+                handler._reply(
+                    404 if not self.dispatcher.is_known(req.task_id) else 503)
+                return
+            resp = api.local.WaitForCxxTaskResponse(
+                exit_code=result.exit_code,
+                output=result.standard_output.decode(errors="replace"),
+                error=result.standard_error.decode(errors="replace"),
+            )
+            file_keys = sorted(result.files)
+            chunks = [b""]  # placeholder for json
+            for key in file_keys:
+                resp.file_extensions.append(key)
+                pl = resp.patches.add(file_key=key)
+                for pos, total, suffix in result.patches.get(key, []):
+                    pl.locations.add(position=pos, total_size=total,
+                                     suffix_to_keep=suffix)
+                chunks.append(result.files[key])
+            chunks[0] = _to_json(resp)
+            self.dispatcher.free_task(req.task_id)
+            handler._reply(200, make_multi_chunk(chunks),
+                           content_type="application/octet-stream")
+            return
+        handler._reply(404)

@@ -1,0 +1,175 @@
+"""Grant prefetching and batching.
+
+Parity with reference yadcc/daemon/local/task_grant_keeper.{h,cc}: one
+fetcher thread per compilation environment pulls grants from the
+scheduler, requesting `immediate = waiters` plus one prefetch so the
+next task usually finds a grant already queued (latency hiding —
+task_grant_keeper.cc:117-183).  Grants carry a 15s lease minus a 5s
+network-tolerance margin; stale queue entries are freed back rather
+than handed out.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ... import api
+from ...rpc import Channel, RpcError
+from ...utils.logging import get_logger
+
+logger = get_logger("daemon.grant_keeper")
+
+_LEASE_S = 15.0
+_NETWORK_TOLERANCE_S = 5.0
+
+
+@dataclass
+class Grant:
+    grant_id: int
+    servant_location: str
+    usable_until: float
+
+
+class _EnvFetcher:
+    def __init__(self, keeper: "TaskGrantKeeper", env_digest: str):
+        self.keeper = keeper
+        self.env_digest = env_digest
+        self.queue: "queue.Queue[Grant]" = queue.Queue()
+        self.waiters = 0
+        self.lock = threading.Lock()
+        self.wake = threading.Event()
+        self.thread = threading.Thread(
+            target=self._loop, name=f"grant-fetch-{env_digest[:8]}",
+            daemon=True)
+        self.thread.start()
+
+    def get(self, timeout_s: float) -> Optional[Grant]:
+        deadline = time.monotonic() + timeout_s
+        with self.lock:
+            self.waiters += 1
+        self.wake.set()
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                try:
+                    g = self.queue.get(timeout=min(remaining, 0.5))
+                except queue.Empty:
+                    self.wake.set()  # fetcher may have gone idle
+                    continue
+                if g.usable_until > time.monotonic():
+                    return g
+                # Expired while queued: return it to the scheduler.
+                self.keeper._free_async([g.grant_id])
+        finally:
+            with self.lock:
+                self.waiters -= 1
+
+    def _loop(self) -> None:
+        while not self.keeper._stopping.is_set():
+            self.wake.wait(timeout=0.5)
+            self.wake.clear()
+            with self.lock:
+                waiters = self.waiters
+            backlog = self.queue.qsize()
+            if waiters <= backlog:
+                continue  # queued grants already cover the demand
+            immediate = waiters - backlog
+            grants = self.keeper._fetch(self.env_digest, immediate,
+                                        prefetch=1)
+            now = time.monotonic()
+            for gid, location in grants:
+                self.queue.put(Grant(
+                    gid, location,
+                    usable_until=now + _LEASE_S - _NETWORK_TOLERANCE_S))
+            if not grants:
+                time.sleep(0.1)  # scheduler dry: don't hammer it
+
+
+class TaskGrantKeeper:
+    def __init__(self, scheduler_uri: str, token: str,
+                 min_version: int = 0):
+        self._uri = scheduler_uri
+        self._token = token
+        self._min_version = min_version
+        self._lock = threading.Lock()
+        self._fetchers: Dict[str, _EnvFetcher] = {}
+        self._stopping = threading.Event()
+        self._channel: Optional[Channel] = None
+
+    def get(self, env_digest: str, timeout_s: float = 10.0) -> Optional[Grant]:
+        with self._lock:
+            f = self._fetchers.get(env_digest)
+            if f is None:
+                f = _EnvFetcher(self, env_digest)
+                self._fetchers[env_digest] = f
+        return f.get(timeout_s)
+
+    def free(self, grant_ids) -> None:
+        self._free_async(list(grant_ids))
+
+    def keep_alive(self, grant_ids) -> list:
+        """Renew leases in batch; returns per-grant success."""
+        try:
+            resp, _ = self._chan().call(
+                "ytpu.SchedulerService", "KeepTaskAlive",
+                api.scheduler.KeepTaskAliveRequest(
+                    token=self._token,
+                    task_grant_ids=list(grant_ids),
+                    next_keep_alive_in_ms=int(_LEASE_S * 1000)),
+                api.scheduler.KeepTaskAliveResponse, timeout=5.0)
+            return list(resp.statuses)
+        except RpcError as e:
+            logger.warning("KeepTaskAlive failed: %s", e)
+            return [False] * len(list(grant_ids))
+
+    def stop(self) -> None:
+        self._stopping.set()
+
+    # -- internals -----------------------------------------------------------
+
+    def _chan(self) -> Channel:
+        with self._lock:
+            if self._channel is None:
+                self._channel = Channel(self._uri)
+            return self._channel
+
+    def _fetch(self, env_digest: str, immediate: int, prefetch: int):
+        req = api.scheduler.WaitForStartingTaskRequest(
+            token=self._token,
+            milliseconds_to_wait=5000,
+            immediate_reqs=immediate,
+            prefetch_reqs=prefetch,
+            next_keep_alive_in_ms=int(_LEASE_S * 1000),
+            min_version=self._min_version,
+        )
+        req.env_desc.compiler_digest = env_digest
+        try:
+            resp, _ = self._chan().call(
+                "ytpu.SchedulerService", "WaitForStartingTask", req,
+                api.scheduler.WaitForStartingTaskResponse, timeout=8.0)
+            return [(g.task_grant_id, g.servant_location)
+                    for g in resp.grants]
+        except RpcError:
+            return []
+
+    def _free_async(self, grant_ids) -> None:
+        if not grant_ids:
+            return
+
+        def run():
+            try:
+                self._chan().call(
+                    "ytpu.SchedulerService", "FreeTask",
+                    api.scheduler.FreeTaskRequest(
+                        token=self._token, task_grant_ids=grant_ids),
+                    api.scheduler.FreeTaskResponse, timeout=5.0)
+            except RpcError:
+                pass  # lease expiry will reclaim it
+
+        threading.Thread(target=run, name="grant-free", daemon=True).start()

@@ -1,0 +1,35 @@
+"""Keyed buffer packing for attachments.
+
+Parity with the reference's keyed KV attachment packing
+(yadcc/daemon/local/packing.cc, consumed by remote_task.cc:69-75 and the
+delegate): output files travel as one attachment holding alternating
+key/value chunks in multi-chunk framing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+
+
+def pack_keyed_buffers(buffers: Dict[str, bytes]) -> bytes:
+    chunks: List[bytes] = []
+    for key in sorted(buffers):
+        chunks.append(key.encode())
+        chunks.append(buffers[key])
+    return make_multi_chunk(chunks)
+
+
+def try_unpack_keyed_buffers(data: bytes) -> Optional[Dict[str, bytes]]:
+    chunks = try_parse_multi_chunk(data)
+    if chunks is None or len(chunks) % 2 != 0:
+        return None
+    out: Dict[str, bytes] = {}
+    for i in range(0, len(chunks), 2):
+        try:
+            key = chunks[i].decode()
+        except UnicodeDecodeError:
+            return None
+        out[key] = chunks[i + 1]
+    return out

@@ -39,13 +39,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--acceptable-servant-tokens", default="")
     p.add_argument("--servant-min-memory-for-new-task",
                    default="10G")
+    p.add_argument("--allow-self-dispatch", action="store_true",
+                   help="let a machine compile its own submissions via "
+                        "the network path (single-machine rigs/tests; "
+                        "normally wasteful, hence off)")
     return p
 
 
 def scheduler_start(args) -> None:
     from ..common.parse_size import parse_size
 
-    policy = make_policy(args.dispatch_policy, args.max_servants)
+    policy = make_policy(args.dispatch_policy, args.max_servants,
+                         avoid_self=not args.allow_self_dispatch)
     dispatcher = TaskDispatcher(
         policy,
         max_servants=args.max_servants,

@@ -157,9 +157,13 @@ class JaxBatchedPolicy(DispatchPolicy):
         return picks_all
 
 
-def make_policy(name: str, max_servants: int) -> DispatchPolicy:
+def make_policy(name: str, max_servants: int,
+                avoid_self: bool = True) -> DispatchPolicy:
+    from dataclasses import replace
+
+    cm = replace(DEFAULT_COST_MODEL, avoid_self=avoid_self)
     if name == "greedy_cpu":
-        return GreedyCpuPolicy()
+        return GreedyCpuPolicy(cm)
     if name == "jax_batched":
-        return JaxBatchedPolicy(max_servants)
+        return JaxBatchedPolicy(max_servants, cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")
