@@ -58,17 +58,11 @@ static int is_linemarker_format(const char *fmt) {
   return strcmp(fmt, "# %u \"%s\"%s") == 0;
 }
 
-int fprintf(FILE *stream, const char *fmt, ...) {
-  va_list ap;
-  int rc;
-
-  init_once();
-  va_start(ap, fmt);
+static int handle_call(FILE *stream, const char *fmt, va_list ap) {
   if (g_compiler_root != NULL && is_linemarker_format(fmt)) {
     unsigned line = va_arg(ap, unsigned);
     const char *path = va_arg(ap, const char *);
     const char *flags = va_arg(ap, const char *);
-    va_end(ap);
     if (path != NULL &&
         strncmp(path, g_compiler_root, g_compiler_root_len) == 0) {
       return emit(stream, "# %u \"%s%s\"%s", line, FAKE_PREFIX,
@@ -76,7 +70,29 @@ int fprintf(FILE *stream, const char *fmt, ...) {
     }
     return emit(stream, "# %u \"%s\"%s", line, path, flags);
   }
-  rc = real_vfprintf != NULL ? real_vfprintf(stream, fmt, ap) : -1;
+  return real_vfprintf != NULL ? real_vfprintf(stream, fmt, ap) : -1;
+}
+
+int fprintf(FILE *stream, const char *fmt, ...) {
+  va_list ap;
+  int rc;
+  init_once();
+  va_start(ap, fmt);
+  rc = handle_call(stream, fmt, ap);
+  va_end(ap);
+  return rc;
+}
+
+/* Fortified builds (_FORTIFY_SOURCE, the default on most distros) route
+ * fprintf through __fprintf_chk; interpose it too or the shim silently
+ * does nothing for exactly the gcc binaries it matters for. */
+int __fprintf_chk(FILE *stream, int flag, const char *fmt, ...) {
+  va_list ap;
+  int rc;
+  (void)flag;
+  init_once();
+  va_start(ap, fmt);
+  rc = handle_call(stream, fmt, ap);
   va_end(ap);
   return rc;
 }

@@ -267,6 +267,20 @@ class TestCacheService:
         assert resp.incremental
         assert "K2" in list(resp.newly_populated_keys)
 
+    def test_sync_predating_server_restart_forced_full(self, service):
+        # A client whose last fetch happened before this server instance
+        # started must get a full filter: the incremental deque cannot
+        # cover pre-restart keys.
+        ch = Channel("mock://cache")
+        service.clock.advance(20)
+        resp, att = ch.call(
+            "ytpu.CacheService", "FetchBloomFilter",
+            api.cache.FetchBloomFilterRequest(
+                token="user", seconds_since_last_full_fetch=300,
+                seconds_since_last_fetch=60),  # 60 > 20s of server life
+            api.cache.FetchBloomFilterResponse)
+        assert not resp.incremental and att
+
     def test_stale_sync_forced_full(self, service):
         ch = Channel("mock://cache")
         resp, att = ch.call(

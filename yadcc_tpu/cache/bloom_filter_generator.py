@@ -43,6 +43,10 @@ class BloomFilterGenerator:
         self._filter = bloom.SaltedBloomFilter(num_bits, num_hashes,
                                                self._salt)
         self._new_keys: Deque[Tuple[float, str]] = deque()
+        # Incremental sync can only cover windows this instance actually
+        # observed; after a restart, older sync points need a full fetch
+        # or clients would silently miss pre-restart keys.
+        self._started = clock.now()
 
     @property
     def num_hashes(self) -> int:
@@ -68,10 +72,15 @@ class BloomFilterGenerator:
             cutoff = now - within_s
             return [k for t, k in self._new_keys if t >= cutoff]
 
-    def can_serve_incremental(self, within_s: float) -> bool:
-        """The deque only reaches back _NEW_KEY_RETENTION_S; older sync
-        points require a full fetch."""
-        return within_s < _NEW_KEY_RETENTION_S
+    def can_serve_incremental(self, age_s: float) -> bool:
+        """`age_s` is the client's raw time-since-last-fetch.  Serveable
+        iff that sync point falls within both the retention window
+        (minus headroom for the caller's compensation margin) and this
+        instance's own lifetime — a client that last synced before a
+        restart must take a full fetch, or pre-restart keys are silently
+        missing from its replica."""
+        observed = self._clock.now() - self._started
+        return age_s < _NEW_KEY_RETENTION_S - 60.0 and age_s <= observed
 
     def rebuild(self, keys: Iterable[str]) -> None:
         """Repopulate from an authoritative key enumeration.

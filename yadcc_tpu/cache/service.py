@@ -85,8 +85,7 @@ class CacheService:
             req.seconds_since_last_full_fetch > 0
             and age > 0
             and age <= _MAX_INCREMENTAL_AGE_S
-            and self.bloom.can_serve_incremental(
-                age + _INCREMENTAL_COMPENSATION_S)
+            and self.bloom.can_serve_incremental(age)
         )
         if can_incremental:
             resp.incremental = True

@@ -103,7 +103,12 @@ def entry(argv: List[str]) -> int:
     )
     if rewritten.directives_only:
         remote_args += ["-fpreprocessed", "-fdirectives-only"]
-    invocation = " ".join(remote_args)
+    # shlex-quote each element: the servant runs the command through
+    # `sh -c`, so args with spaces/metacharacters (-DMSG='a b') must
+    # survive the round trip intact.
+    import shlex
+
+    invocation = " ".join(shlex.quote(a) for a in remote_args)
 
     source = args.sources[0]
     for attempt in range(_CLOUD_RETRIES):
@@ -120,10 +125,15 @@ def entry(argv: List[str]) -> int:
         except CloudError as e:
             log.warning(f"cloud attempt {attempt + 1} failed: {e}")
             continue
-        if result.exit_code == 127:
-            # Servant-side environment trouble, not a compile error:
-            # retry elsewhere (reference yadcc-cxx.cc:214-222).
-            log.warning("servant could not run the compiler; retrying")
+        if result.exit_code < 0 or result.exit_code == 127:
+            # Negative codes are daemon-synthesized failures (no
+            # capacity, servant lost, internal error) and 127 is
+            # servant-side environment trouble — neither is a compile
+            # error, so retry / fall back rather than failing the build
+            # (reference yadcc-cxx.cc:214-222).
+            log.warning(
+                f"cloud infrastructure failure ({result.exit_code}): "
+                f"{result.standard_error[:200]}; retrying")
             continue
         if result.exit_code != 0:
             # A genuine compile error: print diagnostics, pass it through.

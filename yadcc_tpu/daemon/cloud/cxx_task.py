@@ -121,9 +121,9 @@ class CloudCxxCompilationTask:
         # The attachment is already-preprocessed source; tell the
         # compiler so via -x …-cpp-output (when the client preprocessed
         # with -fdirectives-only, it keeps "-fpreprocessed
-        # -fdirectives-only" in the forwarded arguments).
-        lowered = self.source_path.lower()
-        language = "c" if lowered.endswith(".c") else "c++"
+        # -fdirectives-only" in the forwarded arguments).  Suffix check
+        # is case-SENSITIVE: 'Foo.C' is C++ by GCC convention.
+        language = "c" if self.source_path.endswith((".c", ".i")) else "c++"
         self._source_ext = ".i" if language == "c" else ".ii"
         src_file = f"{self.workspace.path}/src{self._source_ext}"
         with open(src_file, "wb") as fp:

@@ -216,9 +216,10 @@ class DaemonService:
 
     def FreeTask(self, req, attachment, ctx):
         self._verify(req.token)
-        self.engine.free_task(req.task_id)
-        with self._lock:
-            self._results.pop(req.task_id, None)
+        if self.engine.free_task(req.task_id):
+            # Fully released: no joined waiter still needs the result.
+            with self._lock:
+                self._results.pop(req.task_id, None)
         return api.daemon.FreeDaemonTaskResponse()
 
     # -- heartbeat pacemaker -------------------------------------------------

@@ -214,16 +214,19 @@ class ExecutionEngine:
 
     # -- freeing / killing ---------------------------------------------------
 
-    def free_task(self, task_id: int) -> None:
+    def free_task(self, task_id: int) -> bool:
+        """Drop one reference; True when the task is fully released (so
+        the caller may also discard any associated results)."""
         with self._lock:
             task = self._tasks.get(task_id)
             if task is None:
-                return
+                return True
             task.ref_count -= 1
             if task.ref_count > 0:
-                return
+                return False
             self._tasks.pop(task_id, None)
         self._kill(task)
+        return True
 
     def kill_expired_tasks(self, expired_grant_ids: List[int]) -> None:
         """Heartbeat feedback: the scheduler disowned these grants
@@ -232,7 +235,10 @@ class ExecutionEngine:
         victims = []
         with self._lock:
             for tid, t in list(self._tasks.items()):
-                if t.grant_id in expired:
+                # Only RUNNING work is killed: a finished compile whose
+                # grant lapsed still has a waiter coming for its output
+                # (completed retention is the GC timer's job).
+                if t.grant_id in expired and t.completed_at is None:
                     victims.append(self._tasks.pop(tid))
         for t in victims:
             logger.warning("killing task %d (grant %d expired)", t.task_id,
