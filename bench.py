@@ -3,8 +3,9 @@
 Simulates the BASELINE.json target scenario — a 5000-servant pool with
 heterogeneous capacities and environments, grant requests arriving in
 micro-batches — and measures end-to-end dispatch throughput through the
-same path the production JaxBatchedPolicy uses (host snapshot upload +
-jitted kernel + picks download), plus per-batch latency percentiles.
+same path the production JaxGroupedPolicy uses (host snapshot upload +
+one jitted threshold-search per descriptor group + counts download),
+plus per-batch latency percentiles.
 
 Target (BASELINE.md): >= 50,000 assignments/sec with p99 dispatch
 latency < 2ms.  Prints ONE JSON line for the driver.
@@ -43,12 +44,19 @@ def main() -> None:
     env_bitmap = rng.integers(0, 2**32, (S, E_WORDS),
                               dtype=np.uint64).astype(np.uint32)
 
-    def make_batch_np(i):
-        return (
-            rng.integers(0, E_WORDS * 32, T).astype(np.int32),
-            np.ones(T, np.int32),
-            np.full(T, -1, np.int32),
-        )
+    from yadcc_tpu.ops import assignment_grouped as asg
+
+    # A micro-batch fans T requests over a handful of distinct compiler
+    # environments (one build floods one env); the grouped kernel
+    # resolves each group with one parallel threshold search.
+    G = int(os.environ.get("BENCH_GROUPS", 4))
+    G_PAD = max(8, G)
+
+    def make_groups(i):
+        envs = rng.integers(0, E_WORDS * 32, G)
+        sizes = np.full(G, T // G, np.int32)
+        sizes[: T % G] += 1  # groups sum exactly to the reported T
+        return [(int(e), 1, -1, int(m)) for e, m in zip(envs, sizes)]
 
     running = np.zeros(S, np.int32)
     granted = 0
@@ -57,7 +65,7 @@ def main() -> None:
     total_capacity = int(capacity[alive].sum())
     start_all = None
     for i in range(WARMUP + BATCHES):
-        env_ids, minv, req = make_batch_np(i)
+        groups = make_groups(i)
         t0 = time.perf_counter()
         pool = asn.PoolArrays(
             alive=jnp.asarray(alive),
@@ -67,21 +75,16 @@ def main() -> None:
             version=jnp.asarray(version),
             env_bitmap=jnp.asarray(env_bitmap),
         )
-        batch = asn.TaskBatch(
-            env_id=jnp.asarray(env_ids),
-            min_version=jnp.asarray(minv),
-            requestor=jnp.asarray(req),
-            valid=jnp.ones(T, bool),
-        )
-        picks, new_running = asn.assign_batch(pool, batch)
-        picks.block_until_ready()
+        batch = asg.make_grouped_batch(groups, pad_to=G_PAD)
+        counts, new_running = asg.assign_grouped(pool, batch)
+        counts.block_until_ready()
         t1 = time.perf_counter()
         if i < WARMUP:
             start_all = time.perf_counter()
             continue
         latencies.append(t1 - t0)
         running = np.asarray(new_running)
-        granted += int((np.asarray(picks) >= 0).sum())
+        granted += int(np.asarray(counts).sum())
         # Steady state: free grants before the pool saturates, like the
         # production FreeTask stream would.
         if running.sum() > total_capacity * 0.5:
@@ -99,6 +102,7 @@ def main() -> None:
         "p99_batch_latency_ms": round(p99_ms, 3),
         "batch_size": T,
         "pool_size": S,
+        "kernel": "grouped",
         "device": str(jax.devices()[0]),
     }))
 

@@ -1,0 +1,177 @@
+"""Grouped (threshold-search) kernel vs the sequential greedy oracle.
+
+The contract: for a batch of request groups (identical descriptors
+within a group, processed in group order), the per-group grant count
+vector per servant and the final running array must match running the
+oracle over the expanded task list exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from yadcc_tpu.models.cost import DispatchCostModel
+from yadcc_tpu.ops import assignment as asn
+from yadcc_tpu.ops import assignment_grouped as asg
+
+from .test_assignment import random_pool_np, to_pool_arrays
+
+
+def oracle_group_counts(pool_np, groups, cm=None):
+    """Expand groups -> sequential greedy -> per-group servant counts."""
+    s = len(pool_np["alive"])
+    tasks = []
+    bounds = []
+    for env_id, minv, req, m in groups:
+        bounds.append((len(tasks), len(tasks) + m))
+        tasks.extend([(env_id, minv, req)] * m)
+    kwargs = {"cost_model": cm} if cm else {}
+    picks = asn.greedy_assign(pool_np, tasks, **kwargs)
+    counts = np.zeros((len(groups), s), np.int32)
+    for gi, (lo, hi) in enumerate(bounds):
+        for p in picks[lo:hi]:
+            if p != asn.NO_PICK:
+                counts[gi, p] += 1
+    return counts, pool_np["running"]
+
+
+def run_kernel(pool_np, groups, pad_to=8, cm=None):
+    pool = to_pool_arrays(pool_np)
+    batch = asg.make_grouped_batch(groups, pad_to=pad_to)
+    kwargs = {"cost_model": cm} if cm else {}
+    counts, running = asg.assign_grouped(pool, batch, **kwargs)
+    return np.asarray(counts[: len(groups)]), np.asarray(running)
+
+
+class TestGroupedVsOracle:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_pools_match(self, seed):
+        rng = np.random.default_rng(seed)
+        s = 96
+        pool_np = random_pool_np(rng, s)
+        groups = [
+            (int(rng.integers(0, 256)), int(rng.integers(1, 4)),
+             int(rng.integers(-1, s)), int(rng.integers(1, 40)))
+            for _ in range(int(rng.integers(1, 6)))
+        ]
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want_counts, want_running = oracle_group_counts(oracle_pool, groups)
+        got_counts, got_running = run_kernel(pool_np, groups)
+        assert np.array_equal(got_counts, want_counts), (
+            f"seed {seed}: counts diverge\n{got_counts}\nvs\n{want_counts}")
+        assert np.array_equal(got_running, want_running)
+
+    def test_single_big_group_exhausts_capacity(self):
+        rng = np.random.default_rng(99)
+        pool_np = random_pool_np(rng, 64)
+        groups = [(7, 1, -1, 500)]  # far more than total capacity
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want, want_run = oracle_group_counts(oracle_pool, groups)
+        got, got_run = run_kernel(pool_np, groups)
+        assert np.array_equal(got, want)
+        assert np.array_equal(got_run, want_run)
+
+    def test_dedicated_tier_crossover(self):
+        # One dedicated servant crossing the 50% preference threshold
+        # mid-group, competing with an idle user node.
+        pool_np = {
+            "alive": np.array([True, True]),
+            "capacity": np.array([10, 10], np.int32),
+            "running": np.array([3, 0], np.int32),
+            "dedicated": np.array([True, False]),
+            "version": np.ones(2, np.int32),
+            "env_bitmap": np.full((2, 8), 0xFFFFFFFF, np.uint32),
+        }
+        groups = [(0, 1, -1, 9)]
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want, _ = oracle_group_counts(oracle_pool, groups)
+        got, _ = run_kernel(pool_np, groups)
+        assert np.array_equal(got, want)
+        # Sanity: dedicated takes grants up to ~50%, the user node the rest.
+        assert got[0, 0] >= 2 and got[0, 1] >= 1
+
+    def test_self_avoidance_and_version(self):
+        pool_np = {
+            "alive": np.array([True, True, True]),
+            "capacity": np.array([8, 8, 8], np.int32),
+            "running": np.zeros(3, np.int32),
+            "dedicated": np.zeros(3, bool),
+            "version": np.array([1, 2, 3], np.int32),
+            "env_bitmap": np.full((3, 8), 0xFFFFFFFF, np.uint32),
+        }
+        groups = [(0, 2, 1, 10)]  # min_version 2, requestor is slot 1
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want, _ = oracle_group_counts(oracle_pool, groups)
+        got, _ = run_kernel(pool_np, groups)
+        assert np.array_equal(got, want)
+        assert got[0, 0] == 0  # version-gated
+        assert got[0, 1] == 0  # self
+        assert got[0, 2] == 8  # capacity-capped
+
+    def test_no_self_avoid_cost_model(self):
+        cm = DispatchCostModel(avoid_self=False)
+        pool_np = {
+            "alive": np.array([True]),
+            "capacity": np.array([4], np.int32),
+            "running": np.zeros(1, np.int32),
+            "dedicated": np.zeros(1, bool),
+            "version": np.ones(1, np.int32),
+            "env_bitmap": np.full((1, 8), 0xFFFFFFFF, np.uint32),
+        }
+        groups = [(0, 1, 0, 3)]
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want, _ = oracle_group_counts(oracle_pool, groups, cm)
+        got, _ = run_kernel(pool_np, groups, cm=cm)
+        assert np.array_equal(got, want)
+        assert got[0, 0] == 3
+
+    def test_zero_count_padding_is_inert(self):
+        rng = np.random.default_rng(5)
+        pool_np = random_pool_np(rng, 32)
+        groups = [(3, 1, -1, 4)]
+        got, run1 = run_kernel(pool_np, groups, pad_to=8)
+        assert int(got.sum()) == int(run1.sum() - pool_np["running"].sum())
+
+    def test_interleaved_requests_match_oracle_via_policy(self):
+        # Requests [A, B, A] on a servant with room for 2: request order
+        # must win (A, B granted; second A starved), NOT group order
+        # (both A's granted).  The run-splitting policy preserves this.
+        from yadcc_tpu.scheduler.policy import (
+            AssignRequest,
+            GreedyCpuPolicy,
+            JaxGroupedPolicy,
+            PoolSnapshot,
+        )
+
+        snap = PoolSnapshot(
+            alive=np.array([True]),
+            capacity=np.array([2], np.int32),
+            running=np.zeros(1, np.int32),
+            dedicated=np.zeros(1, bool),
+            version=np.ones(1, np.int32),
+            env_bitmap=np.full((1, 8), 0xFFFFFFFF, np.uint32),
+        )
+        reqs = [AssignRequest(0, 1, -1), AssignRequest(1, 1, -1),
+                AssignRequest(0, 1, -1)]
+        want = GreedyCpuPolicy().assign(snap, reqs)
+        got = JaxGroupedPolicy(max_groups=8).assign(snap, reqs)
+        assert got == want == [0, 0, asn.NO_PICK]
+
+    def test_interleaved_groups_share_capacity(self):
+        # Group 2 sees the capacity consumed by group 1.
+        pool_np = {
+            "alive": np.array([True]),
+            "capacity": np.array([5], np.int32),
+            "running": np.zeros(1, np.int32),
+            "dedicated": np.zeros(1, bool),
+            "version": np.ones(1, np.int32),
+            "env_bitmap": np.full((1, 8), 0xFFFFFFFF, np.uint32),
+        }
+        groups = [(0, 1, -1, 3), (1, 1, -1, 5)]
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        want, _ = oracle_group_counts(oracle_pool, groups)
+        got, got_run = run_kernel(pool_np, groups)
+        assert np.array_equal(got, want)
+        assert got[0, 0] == 3 and got[1, 0] == 2
+        assert int(got_run[0]) == 5

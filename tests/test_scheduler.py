@@ -40,11 +40,17 @@ def make_servant(location, capacity=16, dedicated=False, envs=(ENV,),
     )
 
 
-@pytest.fixture(params=["greedy_cpu", "jax_batched"])
+@pytest.fixture(params=["greedy_cpu", "jax_batched", "jax_grouped"])
 def dispatcher(request):
+    from yadcc_tpu.scheduler.policy import JaxGroupedPolicy
+
     clock = VirtualClock(start=100.0)
-    policy = (GreedyCpuPolicy() if request.param == "greedy_cpu"
-              else JaxBatchedPolicy(max_servants=64, max_batch=32))
+    policy = {
+        "greedy_cpu": lambda: GreedyCpuPolicy(),
+        "jax_batched": lambda: JaxBatchedPolicy(max_servants=64,
+                                                max_batch=32),
+        "jax_grouped": lambda: JaxGroupedPolicy(max_groups=8),
+    }[request.param]()
     d = TaskDispatcher(
         policy, max_servants=64, max_envs=64, clock=clock,
         batch_window_s=0.0, start_dispatch_thread=True,

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -130,21 +130,12 @@ class JaxBatchedPolicy(DispatchPolicy):
         self._max_servants = max_servants
 
     def assign(self, snap, requests):
-        import jax.numpy as jnp
-
         picks_all: List[int] = []
         # Chunk oversized request lists; capacity carries through `running`.
         running = snap.running.copy()
         for start in range(0, len(requests), self._max_batch):
             chunk = requests[start : start + self._max_batch]
-            pool = asn.PoolArrays(
-                alive=jnp.asarray(snap.alive),
-                capacity=jnp.asarray(snap.capacity),
-                running=jnp.asarray(running),
-                dedicated=jnp.asarray(snap.dedicated),
-                version=jnp.asarray(snap.version),
-                env_bitmap=jnp.asarray(snap.env_bitmap),
-            )
+            pool = _upload_pool(snap, running)
             batch = asn.make_batch(
                 [r.env_id for r in chunk],
                 [r.min_version for r in chunk],
@@ -157,6 +148,66 @@ class JaxBatchedPolicy(DispatchPolicy):
         return picks_all
 
 
+def _upload_pool(snap: PoolSnapshot, running):
+    """Host snapshot -> device PoolArrays (shared by the jax policies)."""
+    import jax.numpy as jnp
+
+    return asn.PoolArrays(
+        alive=jnp.asarray(snap.alive),
+        capacity=jnp.asarray(snap.capacity),
+        running=jnp.asarray(running),
+        dedicated=jnp.asarray(snap.dedicated),
+        version=jnp.asarray(snap.version),
+        env_bitmap=jnp.asarray(snap.env_bitmap),
+    )
+
+
+class JaxGroupedPolicy(DispatchPolicy):
+    """Fast device policy: RUNS of consecutive identical descriptors are
+    each resolved by one parallel threshold search
+    (ops/assignment_grouped.py) instead of per-request sequential
+    argmins.  Splitting on runs (not global dedup) preserves request
+    order exactly, so outcomes equal the greedy oracle up to permutation
+    *within* a run of identical requests — which request of an identical
+    consecutive set receives which grant is unobservable.  Real batches
+    are run-friendly: one build floods one descriptor."""
+
+    name = "jax_grouped"
+
+    def __init__(self, max_groups: int = 64,
+                 cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
+        self._cm = cost_model
+        self._max_groups = max_groups
+
+    def assign(self, snap, requests):
+        from ..ops import assignment_grouped as asg
+
+        # Runs of consecutive identical descriptors, in request order.
+        runs: List[Tuple[tuple, List[int]]] = []
+        for i, r in enumerate(requests):
+            key = (r.env_id, r.min_version, r.requestor_slot)
+            if runs and runs[-1][0] == key:
+                runs[-1][1].append(i)
+            else:
+                runs.append((key, [i]))
+        picks = [asn.NO_PICK] * len(requests)
+        running = snap.running.copy()
+        for start in range(0, len(runs), self._max_groups):
+            chunk = runs[start : start + self._max_groups]
+            batch = asg.make_grouped_batch(
+                [(k[0], k[1], k[2], len(m)) for k, m in chunk],
+                pad_to=self._max_groups)
+            counts, new_running = asg.assign_grouped(
+                _upload_pool(snap, running), batch, self._cm)
+            counts = np.asarray(counts)
+            running = np.asarray(new_running)
+            for ci, (_, member_idx) in enumerate(chunk):
+                slots = np.repeat(np.arange(len(snap.alive)), counts[ci])
+                for req_idx, slot in zip(member_idx, slots):
+                    picks[req_idx] = int(slot)
+        return picks
+
+
 def make_policy(name: str, max_servants: int,
                 avoid_self: bool = True) -> DispatchPolicy:
     from dataclasses import replace
@@ -166,4 +217,6 @@ def make_policy(name: str, max_servants: int,
         return GreedyCpuPolicy(cm)
     if name == "jax_batched":
         return JaxBatchedPolicy(max_servants, cost_model=cm)
+    if name == "jax_grouped":
+        return JaxGroupedPolicy(cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")
