@@ -1,0 +1,33 @@
+"""Trace-replay A/B harness tests (BASELINE configs[1] scenario,
+downsized for unit-test speed; the CLI runs the full 6k x 128)."""
+
+import json
+
+from yadcc_tpu.tools import trace_replay
+
+
+class TestTraceReplay:
+    def test_generate_and_replay_all_policies_agree(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        trace_replay.generate_trace(path, tasks=400, servants=48,
+                                    batch=50, envs=8, seed=3)
+        results = trace_replay.replay(path)
+        assert set(results) == {"greedy_cpu", "jax_batched", "jax_grouped"}
+        grants = {r["granted"] for r in results.values()}
+        assert len(grants) == 1 and grants.pop() > 0
+        assert all(r["matches_reference"] for r in results.values())
+        finals = {r["final_running"] for r in results.values()}
+        assert len(finals) == 1
+
+    def test_trace_format_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        trace_replay.generate_trace(path, tasks=60, servants=8, batch=20,
+                                    envs=4, seed=1)
+        events = [json.loads(l) for l in open(path)]
+        assert events[0]["kind"] == "pool"
+        assert len(events[0]["servants"]) == 8
+        kinds = {e["kind"] for e in events[1:]}
+        assert kinds == {"batch", "free"}
+        total = sum(len(e["requests"]) for e in events
+                    if e["kind"] == "batch")
+        assert total == 60

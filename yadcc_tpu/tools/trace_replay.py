@@ -1,0 +1,189 @@
+"""Scheduler trace replay: greedy-vs-JAX A/B harness.
+
+BASELINE.json configs[1]: "recorded scheduler trace replay: 6k tasks x
+128 workers, greedy-vs-JAX A/B".  A trace is a JSONL file of dispatch
+micro-batches:
+
+    {"kind": "pool", "servants": [{"capacity": 16, "dedicated": false,
+        "version": 1, "envs": [0, 3]}, ...]}
+    {"kind": "batch", "requests": [[env_id, min_version, requestor], ...]}
+    {"kind": "free", "fraction": 0.5}   # each servant frees floor(r*f)
+
+Replaying runs every batch through each policy against the *same*
+evolving pool state, checks outcome equivalence (same per-batch grant
+multiset per consecutive-descriptor run, same running vector — the CLI
+exits non-zero on divergence), and reports throughput per policy
+(first call untimed: jit warmup).
+
+CLI:
+    python -m yadcc_tpu.tools.trace_replay --generate trace.jsonl \\
+        --tasks 6000 --servants 128
+    python -m yadcc_tpu.tools.trace_replay trace.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from collections import Counter
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+from ..scheduler.policy import (
+    AssignRequest,
+    GreedyCpuPolicy,
+    JaxBatchedPolicy,
+    JaxGroupedPolicy,
+    PoolSnapshot,
+)
+
+
+def generate_trace(path: str, *, tasks: int = 6000, servants: int = 128,
+                   batch: int = 64, envs: int = 16, seed: int = 0) -> None:
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as fp:
+        fp.write(json.dumps({
+            "kind": "pool",
+            "servants": [
+                {
+                    "capacity": int(rng.integers(4, 32)),
+                    "dedicated": bool(rng.random() < 0.3),
+                    "version": 1,
+                    "envs": sorted(set(
+                        int(e) for e in rng.integers(0, envs, 3))),
+                }
+                for _ in range(servants)
+            ],
+        }) + "\n")
+        emitted = 0
+        while emitted < tasks:
+            n = min(batch, tasks - emitted)
+            # Bursty env distribution: a few long runs per batch.
+            reqs = []
+            while len(reqs) < n:
+                env = int(rng.integers(0, envs))
+                run = int(rng.integers(1, max(2, n - len(reqs) + 1)))
+                reqs.extend([[env, 1, -1]] * min(run, n - len(reqs)))
+            fp.write(json.dumps({"kind": "batch", "requests": reqs}) + "\n")
+            emitted += n
+            # FreeTask stream: roughly half of each servant's running
+            # grants complete between batches.
+            fp.write(json.dumps({"kind": "free", "fraction": 0.5}) + "\n")
+
+
+def _load(path: str) -> List[dict]:
+    with open(path) as fp:
+        return [json.loads(line) for line in fp if line.strip()]
+
+
+def _snapshot_from_pool(pool_ev: dict, env_words: int = 8) -> PoolSnapshot:
+    servants = pool_ev["servants"]
+    s = len(servants)
+    snap = PoolSnapshot(
+        alive=np.ones(s, bool),
+        capacity=np.array([x["capacity"] for x in servants], np.int32),
+        running=np.zeros(s, np.int32),
+        dedicated=np.array([x["dedicated"] for x in servants], bool),
+        version=np.array([x["version"] for x in servants], np.int32),
+        env_bitmap=np.zeros((s, env_words), np.uint32),
+    )
+    for i, x in enumerate(servants):
+        for e in x["envs"]:
+            snap.env_bitmap[i, e >> 5] |= np.uint32(1 << (e & 31))
+    return snap
+
+
+def _run_multisets(requests: List[AssignRequest],
+                   picks: List[int]) -> List[Counter]:
+    """Grant multisets per consecutive-descriptor run (the equivalence
+    granularity: identical requests are interchangeable)."""
+    out: List[Counter] = []
+    prev_key = None
+    for r, p in zip(requests, picks):
+        key = (r.env_id, r.min_version, r.requestor_slot)
+        if key != prev_key:
+            out.append(Counter())
+            prev_key = key
+        if p >= 0:
+            out[-1][p] += 1
+    return out
+
+
+def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
+    events = _load(path)
+    assert events and events[0]["kind"] == "pool", "trace must open with pool"
+    if policies is None:
+        policies = {
+            "greedy_cpu": GreedyCpuPolicy(),
+            "jax_batched": JaxBatchedPolicy(
+                max_servants=len(events[0]["servants"])),
+            "jax_grouped": JaxGroupedPolicy(),
+        }
+
+    results = {}
+    reference_outcomes = None
+    for name, policy in policies.items():
+        snap = _snapshot_from_pool(events[0])
+        # Untimed warmup: the jit policies pay one-time compilation on
+        # their first call, which must not skew the A/B throughput.
+        policy.assign(
+            PoolSnapshot(snap.alive.copy(), snap.capacity.copy(),
+                         snap.running.copy(), snap.dedicated.copy(),
+                         snap.version.copy(), snap.env_bitmap.copy()),
+            [AssignRequest(0, 1, -1)])
+        outcomes = []
+        granted = 0
+        t0 = time.perf_counter()
+        for ev in events[1:]:
+            if ev["kind"] == "free":
+                # Deterministic and identical across policies (running
+                # vectors agree while policies stay equivalent).
+                snap.running -= (
+                    snap.running * ev["fraction"]).astype(np.int32)
+            elif ev["kind"] == "batch":
+                reqs = [AssignRequest(*r) for r in ev["requests"]]
+                picks = policy.assign(snap, reqs)
+                for p in picks:
+                    if p >= 0:
+                        snap.running[p] += 1
+                        granted += 1
+                outcomes.append(_run_multisets(reqs, picks))
+        elapsed = time.perf_counter() - t0
+        results[name] = {
+            "granted": granted,
+            "seconds": round(elapsed, 4),
+            "assignments_per_sec": round(granted / elapsed, 1),
+            "final_running": int(snap.running.sum()),
+        }
+        if reference_outcomes is None:
+            reference_outcomes = outcomes
+            results[name]["matches_reference"] = True
+        else:
+            results[name]["matches_reference"] = (
+                outcomes == reference_outcomes)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("ytpu-trace-replay")
+    ap.add_argument("trace")
+    ap.add_argument("--generate", action="store_true")
+    ap.add_argument("--tasks", type=int, default=6000)
+    ap.add_argument("--servants", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.generate:
+        generate_trace(args.trace, tasks=args.tasks,
+                       servants=args.servants, seed=args.seed)
+        print(f"wrote {args.trace}")
+        return
+    results = replay(args.trace)
+    print(json.dumps(results, indent=2))
+    if not all(r["matches_reference"] for r in results.values()):
+        raise SystemExit("POLICY DIVERGENCE: outcomes differ from reference")
+
+
+if __name__ == "__main__":
+    main()
