@@ -1,0 +1,51 @@
+"""Pallas scan kernel vs the greedy oracle (interpret mode on CPU; the
+same code compiles natively on TPU)."""
+
+import numpy as np
+import pytest
+
+from yadcc_tpu.ops import assignment as asn
+
+from .test_assignment import random_pool_np, random_tasks, to_pool_arrays
+
+
+class TestPallasAssign:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_oracle(self, seed):
+        from yadcc_tpu.ops.pallas_assign import pallas_assign_batch
+
+        rng = np.random.default_rng(seed)
+        s, t = 64, 64
+        pool_np = random_pool_np(rng, s)
+        tasks = random_tasks(rng, t, s, n_envs=256)
+
+        oracle_pool = {k: v.copy() for k, v in pool_np.items()}
+        expect = asn.greedy_assign(oracle_pool, tasks)
+
+        pool = to_pool_arrays(pool_np)
+        batch = asn.make_batch(
+            [x[0] for x in tasks],
+            [x[1] for x in tasks],
+            [x[2] for x in tasks],
+            pad_to=t,
+        )
+        picks, running = pallas_assign_batch(pool, batch, interpret=True)
+        assert list(np.asarray(picks)) == expect
+        assert np.array_equal(np.asarray(running), oracle_pool["running"])
+
+    def test_padding_rows_inert(self):
+        from yadcc_tpu.ops.pallas_assign import pallas_assign_batch
+
+        import jax.numpy as jnp
+
+        pool = asn.make_pool(8, 64)
+        pool = pool._replace(
+            alive=jnp.asarray(np.ones(8, bool)),
+            capacity=jnp.full(8, 4, jnp.int32),
+            version=jnp.ones(8, jnp.int32),
+            env_bitmap=jnp.full((8, 2), 0xFFFFFFFF, jnp.uint32),
+        )
+        batch = asn.make_batch([0, 0], [1, 1], [-1, -1], pad_to=8)
+        picks, running = pallas_assign_batch(pool, batch, interpret=True)
+        assert (np.asarray(picks[2:]) == asn.NO_PICK).all()
+        assert int(np.asarray(running).sum()) == 2

@@ -208,6 +208,38 @@ class JaxGroupedPolicy(DispatchPolicy):
         return picks
 
 
+class JaxPallasPolicy(JaxBatchedPolicy):
+    """assign_batch semantics via the single-pallas-call kernel
+    (ops/pallas_assign.py): pool state pinned in VMEM across the whole
+    batch.  Compiles natively on TPU; uses the Pallas interpreter
+    elsewhere (slow — for parity testing only)."""
+
+    name = "jax_pallas"
+
+    def assign(self, snap, requests):
+        import jax
+
+        from ..ops.pallas_assign import pallas_assign_batch
+
+        interpret = jax.devices()[0].platform != "tpu"
+        picks_all: List[int] = []
+        running = snap.running.copy()
+        for start in range(0, len(requests), self._max_batch):
+            chunk = requests[start : start + self._max_batch]
+            pool = _upload_pool(snap, running)
+            batch = asn.make_batch(
+                [r.env_id for r in chunk],
+                [r.min_version for r in chunk],
+                [r.requestor_slot for r in chunk],
+                pad_to=self._max_batch,
+            )
+            picks, new_running = pallas_assign_batch(
+                pool, batch, self._cm, interpret=interpret)
+            picks_all.extend(int(p) for p in np.asarray(picks[: len(chunk)]))
+            running = np.asarray(new_running)
+        return picks_all
+
+
 def make_policy(name: str, max_servants: int,
                 avoid_self: bool = True) -> DispatchPolicy:
     from dataclasses import replace
@@ -219,4 +251,6 @@ def make_policy(name: str, max_servants: int,
         return JaxBatchedPolicy(max_servants, cost_model=cm)
     if name == "jax_grouped":
         return JaxGroupedPolicy(cost_model=cm)
+    if name == "jax_pallas":
+        return JaxPallasPolicy(max_servants, cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")

@@ -78,9 +78,11 @@ def _load(path: str) -> List[dict]:
         return [json.loads(line) for line in fp if line.strip()]
 
 
-def _snapshot_from_pool(pool_ev: dict, env_words: int = 8) -> PoolSnapshot:
+def _snapshot_from_pool(pool_ev: dict) -> PoolSnapshot:
     servants = pool_ev["servants"]
     s = len(servants)
+    max_env = max((e for x in servants for e in x["envs"]), default=0)
+    env_words = max(8, (max_env >> 5) + 1)
     snap = PoolSnapshot(
         alive=np.ones(s, bool),
         capacity=np.array([x["capacity"] for x in servants], np.int32),
@@ -128,11 +130,10 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
         snap = _snapshot_from_pool(events[0])
         # Untimed warmup: the jit policies pay one-time compilation on
         # their first call, which must not skew the A/B throughput.
-        policy.assign(
-            PoolSnapshot(snap.alive.copy(), snap.capacity.copy(),
-                         snap.running.copy(), snap.dedicated.copy(),
-                         snap.version.copy(), snap.env_bitmap.copy()),
-            [AssignRequest(0, 1, -1)])
+        # Policies only mutate their own running copy, so a fresh
+        # snapshot for the real run is all the isolation needed.
+        policy.assign(snap, [AssignRequest(0, 1, -1)])
+        snap = _snapshot_from_pool(events[0])
         outcomes = []
         granted = 0
         t0 = time.perf_counter()
