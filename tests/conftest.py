@@ -32,3 +32,17 @@ def tmp_shard_dirs(tmp_path):
     a.mkdir()
     b.mkdir()
     return str(a), str(b)
+
+
+def post_local(port: int, path: str, body: bytes, timeout: float = 15.0):
+    """POST to a LocalHttpService on loopback; (status, body) — shared by
+    the daemon-local and HTTP-robustness test suites."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/octet-stream"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data

@@ -338,13 +338,9 @@ class TestHttpService:
         return ck
 
     def _post(self, svc, path, body):
-        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=15)
-        conn.request("POST", path, body=body,
-                     headers={"Content-Type": "application/octet-stream"})
-        resp = conn.getresponse()
-        data = resp.read()
-        conn.close()
-        return resp.status, data
+        from .conftest import post_local
+
+        return post_local(svc.port, path, body)
 
     def test_get_version(self, http_daemon):
         conn = http.client.HTTPConnection("127.0.0.1", http_daemon.port,

@@ -1,0 +1,102 @@
+"""Adversarial/malformed-input tests for the client-facing HTTP API —
+the one surface that accepts bytes from arbitrary local processes."""
+
+import http.client
+import json
+
+import pytest
+
+from yadcc_tpu.common.multi_chunk import make_multi_chunk
+from yadcc_tpu.daemon.local.distributed_task_dispatcher import \
+    DistributedTaskDispatcher
+from yadcc_tpu.daemon.local.file_digest_cache import FileDigestCache
+from yadcc_tpu.daemon.local.http_service import LocalHttpService
+from yadcc_tpu.daemon.local.local_task_monitor import LocalTaskMonitor
+
+
+class _NullGrants:
+    def get(self, env, timeout_s=0):
+        return None
+
+    def free(self, ids):
+        pass
+
+    def keep_alive(self, ids):
+        return []
+
+
+class _NullConfig:
+    def serving_daemon_token(self):
+        return ""
+
+
+@pytest.fixture(scope="module")
+def svc():
+    service = LocalHttpService(
+        monitor=LocalTaskMonitor(nprocs=4, pid_prober=lambda p: True),
+        digest_cache=FileDigestCache(),
+        dispatcher=DistributedTaskDispatcher(
+            grant_keeper=_NullGrants(), config_keeper=_NullConfig(),
+            pid_prober=lambda p: True),
+        port=0,
+    )
+    service.start()
+    yield service
+    service.stop()
+
+
+def post(svc, path, body: bytes):
+    from .conftest import post_local
+
+    return post_local(svc.port, path, body)
+
+
+class TestMalformedInputs:
+    @pytest.mark.parametrize("body", [
+        b"",                       # empty
+        b"not json at all",        # garbage
+        b"{" * 1000,               # deeply nested junk
+        b'{"task_id": "xyz"}',     # wrong type
+        b"\x00\xff\xfe\xfd" * 10,  # binary noise
+    ])
+    def test_wait_for_cxx_task_bad_bodies(self, svc, body):
+        status, _ = post(svc, "/local/wait_for_cxx_task", body)
+        assert status in (400, 404, 500)  # never a hang or a 200
+
+    @pytest.mark.parametrize("body", [
+        b"",                               # no chunks
+        b"garbage without crlf",
+        b"5\r\nab",                        # length lies
+        make_multi_chunk([b"{}"]),         # one chunk, need two
+        make_multi_chunk([b"{}"] * 5),     # too many chunks
+        make_multi_chunk([b"not json", b"src"]),
+        b"99999999999999999999,1\r\nx",    # absurd length header
+    ])
+    def test_submit_bad_bodies(self, svc, body):
+        status, _ = post(svc, "/local/submit_cxx_task", body)
+        assert status in (400, 500)
+
+    def test_submit_valid_json_missing_fields(self, svc):
+        body = make_multi_chunk([json.dumps({}).encode(), b"src"])
+        status, _ = post(svc, "/local/submit_cxx_task", body)
+        assert status == 400  # unknown compiler digest
+
+    def test_unknown_route(self, svc):
+        status, _ = post(svc, "/local/nope", b"{}")
+        assert status == 404
+
+    def test_acquire_quota_bad_json(self, svc):
+        status, _ = post(svc, "/local/acquire_quota", b"][")
+        assert status in (400, 500)
+
+    def test_release_quota_never_held(self, svc):
+        # Releasing quota that was never acquired must not crash or
+        # corrupt counts.
+        status, _ = post(svc, "/local/release_quota",
+                         b'{"requestor_pid": 999999}')
+        assert status == 200
+        assert svc.monitor.inspect()["heavy_held"] == 0
+
+    def test_get_version_with_post(self, svc):
+        status, _ = post(svc, "/local/get_version", b"")
+        assert status == 404  # GET-only route
