@@ -475,13 +475,16 @@ std::string find_real_compiler(const std::string &invoked) {
   std::string me = sl > 0 ? std::string(self, sl) : "";
   const char *path = getenv("PATH");
   if (!path) return "";
+  const char *farm = getenv("YTPU_WRAPPER_DIR");  // installer's own dir
   std::string p(path);
   size_t pos = 0;
   while (pos <= p.size()) {
     size_t colon = p.find(':', pos);
     if (colon == std::string::npos) colon = p.size();
-    std::string cand = p.substr(pos, colon - pos) + "/" + base;
+    std::string dir = p.substr(pos, colon - pos);
     pos = colon + 1;
+    if (farm && dir == farm) continue;
+    std::string cand = dir + "/" + base;
     char real[4096];
     if (access(cand.c_str(), X_OK) != 0) continue;
     if (!realpath(cand.c_str(), real)) continue;

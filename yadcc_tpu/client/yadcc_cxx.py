@@ -42,8 +42,13 @@ def find_real_compiler(invoked_as: str) -> Optional[str]:
     build accelerators (reference yadcc-cxx.cc:118-140)."""
     name = os.path.basename(invoked_as)
     me = os.path.realpath(sys.argv[0]) if sys.argv else ""
+    # The installer's wrapper scripts mark their own directory: never
+    # resolve back into the farm (that's a fork loop, not a compiler).
+    farm = os.environ.get("YTPU_WRAPPER_DIR", "")
     for d in os.environ.get("PATH", "").split(os.pathsep):
         if not d:
+            continue
+        if farm and os.path.realpath(d) == os.path.realpath(farm):
             continue
         cand = os.path.join(d, name)
         if not (os.path.isfile(cand) and os.access(cand, os.X_OK)):

@@ -1,0 +1,100 @@
+"""Client symlink-farm installer.
+
+Parity with the reference's deployment workflow (yadcc/README.md:21-27,
+yadcc/doc/client.md): the client masquerades as the compiler via
+symlinks placed in a directory that goes FIRST on PATH:
+
+    python -m yadcc_tpu.tools.install_client ~/.ytpu/bin
+    export PATH=~/.ytpu/bin:$PATH
+    make -j256        # unchanged build system, distributed compiles
+
+Prefers the native `ytpu-cxx` binary (native/Makefile) when built;
+falls back to a wrapper script invoking the Python client.  Also
+installs quota-only wrappers for non-distributable tools (javac/jar).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import stat
+import sys
+from pathlib import Path
+
+_CXX_NAMES = ("gcc", "g++", "cc", "c++", "clang", "clang++")
+_WRAPPER_NAMES = ("javac", "jar")
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _native_client() -> Path | None:
+    p = _repo_root() / "native" / "ytpu-cxx"
+    return p if p.exists() else None
+
+
+def _write_script(path: Path, body: str) -> None:
+    # Never write through a stale symlink (a previous native install
+    # would get its real binary clobbered with script text).
+    if path.is_symlink() or path.exists():
+        path.unlink()
+    path.write_text(body)
+    path.chmod(0o755)
+
+
+def install(bin_dir: str, use_python_client: bool = False) -> None:
+    out = Path(bin_dir).expanduser()
+    out.mkdir(parents=True, exist_ok=True)
+    native = None if use_python_client else _native_client()
+    repo = _repo_root()
+
+    if native is not None:
+        # Symlinks straight onto the native binary: it dispatches on
+        # the invoked name (argv[0]) like the reference's yadcc-cxx.
+        target = out / "ytpu-cxx"
+        if target.is_symlink() or target.exists():
+            target.unlink()
+        target.symlink_to(native)
+        for name in _CXX_NAMES:
+            link = out / name
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.symlink_to(native)
+        # The fakeroot shim is found next to the real client binary.
+        print(f"installed native client links in {out}")
+    else:
+        for name in _CXX_NAMES:
+            _write_script(out / name, (
+                "#!/bin/sh\n"
+                f'export PYTHONPATH="{repo}:$PYTHONPATH"\n'
+                # Marks this farm dir so find_real_compiler never
+                # resolves back to these wrappers (fork-loop guard).
+                f'export YTPU_WRAPPER_DIR="{out}"\n'
+                f'exec "{sys.executable}" -m yadcc_tpu.client.yadcc_cxx '
+                f'{name} "$@"\n'))
+        print(f"installed python client wrappers in {out}")
+
+    for name in _WRAPPER_NAMES:
+        _write_script(out / name, (
+            "#!/bin/sh\n"
+            f'export PYTHONPATH="{repo}:$PYTHONPATH"\n'
+            f'export YTPU_WRAPPER_DIR="{out}"\n'
+            f'exec "{sys.executable}" -m yadcc_tpu.client.universal_wrapper '
+            f'{name} "$@"\n'))
+    print(f"quota wrappers: {', '.join(_WRAPPER_NAMES)}")
+    print(f"activate with:  export PATH={out}:$PATH")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("ytpu-install-client")
+    ap.add_argument("bin_dir", help="directory to fill (goes first on PATH)")
+    ap.add_argument("--python-client", action="store_true",
+                    help="force the Python client even if the native "
+                         "binary is built")
+    args = ap.parse_args()
+    install(args.bin_dir, use_python_client=args.python_client)
+
+
+if __name__ == "__main__":
+    main()
