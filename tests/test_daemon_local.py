@@ -274,6 +274,38 @@ class TestDispatcherFlows:
         assert cluster["servant"].queued == 0
         assert d.stats["hit_cache"] == 1
 
+    def test_cache_refill_mode_skips_read_but_fills(self, cluster):
+        # cache_control=2 = Refill (reference distributed_task.h:36,
+        # used by its own cache-cold benchmark): the lookup is skipped
+        # entirely — even with a populated cache the TU compiles — but
+        # cache filling stays enabled (disallow_cache_fill False).
+        entry_bytes = cache_format.write_cache_entry(cache_format.CacheEntry(
+            exit_code=0, standard_output=b"cached", standard_error=b"",
+            files={".o": compress.compress(b"CACHED-OBJ")}))
+
+        reads = []
+
+        class FakeReader:
+            enabled = True
+
+            def try_read(self, key):
+                reads.append(key)
+                return entry_bytes
+
+        d = self._mk(cluster, cache_reader=FakeReader())
+        tid = d.queue_task(make_task(cache_control=2))
+        result = d.wait_for_task(tid, timeout_s=10.0)
+        assert result is not None and result.exit_code == 0
+        assert reads == []  # no lookup RPC at all
+        assert compress.decompress(result.files[".o"]).startswith(b"OBJ:")
+        assert cluster["servant"].queued == 1
+        assert d.stats["hit_cache"] == 0 and d.stats["actually_run"] == 1
+
+    def test_cache_disallow_never_fills(self, cluster):
+        task = make_task(cache_control=0)
+        assert task.get_cache_key() is None
+        assert task.get_cache_setting() == task.CACHE_DISALLOW
+
     def test_join_running_task(self, cluster):
         # Pre-seed the fake servant with task 1 and advertise it.
         servant = cluster["servant"]

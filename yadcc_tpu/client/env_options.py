@@ -5,7 +5,9 @@ semantics documented in yadcc/doc/client.md:15-25 / doc/client/cxx.md:
 the client must not depend on any flag library (startup latency), so all
 configuration is environment variables:
 
-    YTPU_CACHE_CONTROL     0 = off, 1 = read/write (default), 2 = verify
+    YTPU_CACHE_CONTROL     0 = off, 1 = read/write (default),
+                           2 = refill (skip reads, still fill — for
+                           cache-cold benchmarking / cache rebuilds)
     YTPU_LOG_LEVEL         DEBUG/INFO/WARNING/ERROR (default WARNING)
     YTPU_DAEMON_PORT       local daemon port (default 8334)
     YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD

@@ -39,8 +39,14 @@ class CxxCompilationTask(DistributedTask):
     compiler_digest: str
     compressed_source: bytes
 
+    def get_cache_setting(self) -> int:
+        if self.cache_control in (self.CACHE_DISALLOW, self.CACHE_ALLOW,
+                                  self.CACHE_REFILL):
+            return self.cache_control
+        return self.CACHE_ALLOW
+
     def get_cache_key(self) -> Optional[str]:
-        if self.cache_control <= 0:
+        if self.get_cache_setting() == self.CACHE_DISALLOW:
             return None
         return get_cache_key(self.compiler_digest,
                              self.invocation_arguments,

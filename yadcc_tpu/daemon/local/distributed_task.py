@@ -34,6 +34,15 @@ class DistributedTask:
     Implementations must expose `requestor_pid` (0 = unknown) for the
     dispatcher's orphan-kill timer."""
 
+    # Cache policy (reference distributed_task.h:36 CacheControl):
+    CACHE_DISALLOW = 0  # never read, never fill
+    CACHE_ALLOW = 1     # read and fill
+    CACHE_REFILL = 2    # skip the read, (re)fill on completion — used
+    #                     to rebuild a suspect cache without trusting it
+
+    def get_cache_setting(self) -> int:
+        raise NotImplementedError
+
     def get_cache_key(self) -> Optional[str]:
         """None when this task must bypass the cache."""
         raise NotImplementedError

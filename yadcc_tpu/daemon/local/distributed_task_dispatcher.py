@@ -137,6 +137,12 @@ class DistributedTaskDispatcher:
     def _try_read_cache(self, entry: _Entry) -> Optional[TaskResult]:
         if self._cache is None or not self._cache.enabled:
             return None
+        # Only CACHE_ALLOW reads; REFILL (the reference's cache-cold
+        # benchmark mode, YADCC_CACHE_CONTROL=2) skips the lookup but
+        # still fills on completion (reference distributed_task.h:36,
+        # distributed_task_dispatcher.cc:237).
+        if entry.task.get_cache_setting() != entry.task.CACHE_ALLOW:
+            return None
         key = entry.task.get_cache_key()
         if key is None:
             return None
