@@ -387,10 +387,15 @@ void release_quota() {
 
 // --------------------------------------------------------------- args --
 
+// Must stay in sync with yadcc_tpu/client/compiler_args.py
+// _OPTIONS_WITH_VALUE: the two clients must parse identical argv into
+// identical remote invocations, or they diverge on cache keys.
 const char *const kValueOpts[] = {
     "-o", "-x", "-include", "-imacros", "-isystem", "-iquote", "-idirafter",
-    "-isysroot", "-I", "-L", "-D", "-U", "-MF", "-MT", "-MQ", "-arch",
-    "-Xpreprocessor", "-Xassembler", "-Xlinker", "-Xclang", "--param",
+    "-iprefix", "-iwithprefix", "-iwithprefixbefore", "-isysroot", "-I",
+    "-L", "-D", "-U", "-MF", "-MT", "-MQ", "-arch", "-Xpreprocessor",
+    "-Xassembler", "-Xlinker", "-Xclang", "-T", "-u", "-z", "-G",
+    "--param", "-aux-info", "-A", "-l", "-e",
 };
 
 bool takes_value(const std::string &a) {
@@ -599,10 +604,25 @@ bool run_preprocess(const std::string &compiler, const Args &a,
 
 // ------------------------------------------------------------- remote --
 
+// Byte-identical to Python's shlex.quote: the invocation string feeds
+// get_cxx_task_digest/get_cache_key, so a fleet mixing this client with
+// the Python one must produce the same cache keys for the same compile.
+// shlex.quote leaves strings matching [A-Za-z0-9_@%+=:,./-]+ bare and
+// otherwise single-quotes, escaping embedded quotes as '"'"'.
 std::string shell_quote(const std::string &s) {
+  if (s.empty()) return "''";
+  bool safe = true;
+  for (unsigned char c : s) {
+    if ((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+        (c >= '0' && c <= '9') || strchr("_@%+=:,./-", c))
+      continue;
+    safe = false;
+    break;
+  }
+  if (safe) return s;
   std::string out = "'";
   for (char c : s) {
-    if (c == '\'') out += "'\\''";
+    if (c == '\'') out += "'\"'\"'";
     else out += c;
   }
   return out + "'";
@@ -612,11 +632,13 @@ std::string remote_invocation(const Args &a, bool directives_only) {
   std::string inv;
   for (size_t i = 0; i < a.tail.size(); i++) {
     const std::string &t = a.tail[i];
-    bool skip = t == "-c" || t == "-o" || t.rfind("-o", 0) == 0 ||
+    // Same removal set as the Python client (yadcc_cxx.py remote_args
+    // rewrite): exact {-c,-imacros} plus prefixes
+    // {-o,-M,-I,-iquote,-isystem,-include,-Wp,}.
+    bool skip = t == "-c" || t == "-imacros" || t.rfind("-o", 0) == 0 ||
                 t.rfind("-M", 0) == 0 || t.rfind("-I", 0) == 0 ||
                 t.rfind("-iquote", 0) == 0 || t.rfind("-isystem", 0) == 0 ||
-                t.rfind("-include", 0) == 0 || t.rfind("-imacros", 0) == 0 ||
-                t.rfind("-Wp,", 0) == 0;
+                t.rfind("-include", 0) == 0 || t.rfind("-Wp,", 0) == 0;
     bool is_src = false;
     for (const auto &s : a.sources)
       if (t == s) is_src = true;
@@ -691,6 +713,7 @@ FileDescJson file_desc(const std::string &path) {
 
 }  // namespace
 
+#ifndef YTPU_NO_MAIN
 int main(int argc, char **argv) {
   // `ytpu-cxx g++ ...` form: shift so argv[0] is the compiler name.
   std::string self = argv[0];
@@ -870,3 +893,4 @@ int main(int argc, char **argv) {
   logf(30, "cloud failed repeatedly; falling back locally");
   return compile_locally(compiler, argv);
 }
+#endif  // YTPU_NO_MAIN

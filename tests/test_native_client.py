@@ -1,0 +1,115 @@
+"""Cross-client parity tests for the native client (native/client/).
+
+The native ytpu-cxx and the Python client front the same daemon and the
+same cache: identical compiles must yield byte-identical invocation
+strings (they feed the task digest and cache key — reference
+yadcc/daemon/task_digest.cc:25-30) and identical file digests.  A fleet
+mixing the two clients otherwise never shares cache entries (round-1
+advisor finding).
+
+These tests build the real C++ via `make -C native` and drive the
+internals through the ytpu-testtool binary (quote / invocation /
+blake2b modes, NUL-terminated output).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from yadcc_tpu.client.compiler_args import CompilerArgs
+from yadcc_tpu.client.yadcc_cxx import remote_invocation
+from yadcc_tpu.common.hashing import digest_file
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+
+
+@pytest.fixture(scope="session")
+def testtool():
+    """Build the native tools once per test session."""
+    r = subprocess.run(["make", "-C", str(NATIVE), "ytpu-testtool"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"native toolchain unavailable: {r.stderr[-500:]}")
+    return NATIVE / "ytpu-testtool"
+
+
+def run_tool(tool: Path, *argv: str) -> list[str]:
+    out = subprocess.run([str(tool), *argv], capture_output=True,
+                         check=True).stdout
+    assert out.endswith(b"\0")
+    return [p.decode() for p in out[:-1].split(b"\0")]
+
+
+QUOTE_BATTERY = [
+    "",
+    "-O2",
+    "-std=c++17",
+    "a b",
+    "it's",
+    "'''",
+    "-DMSG=a b",
+    '-DQ="quoted"',
+    "tab\there",
+    "new\nline",
+    "~user",
+    "a;b|c&d",
+    "$(rm -rf /)",
+    "`backtick`",
+    "ünïcödé",
+    "_@%+=:,./-",
+    "-",
+    "--",
+    "*glob?",
+    "back\\slash",
+]
+
+
+def test_shell_quote_matches_shlex(testtool):
+    got = run_tool(testtool, "quote", *QUOTE_BATTERY)
+    want = [shlex.quote(a) for a in QUOTE_BATTERY]
+    assert got == want
+
+
+INVOCATION_CASES = [
+    # (argv tail, source file names inside it)
+    ["g++", "-O2", "-std=c++17", "-c", "foo.cc", "-o", "foo.o"],
+    ["g++", "-c", "x.cc", "-I", "/inc", "-I/other", "-isystem", "/sys",
+     "-DA=1", "-DMSG=a b", "-Wall", "-o/tmp/x.o"],
+    ["gcc", "-MMD", "-MF", "dep.d", "-MT", "tgt", "-c", "a.c",
+     "-include", "pre.h", "-Wp,-DX", "-o", "a.o"],
+    ["clang++", "-c", "s.cpp", "--param", "max-inline-insns=42",
+     "-Xclang", "-foo", "-iquote", "q", "-imacros", "m.h"],
+    ["g++", "-fno-exceptions", "-c", "w.cxx", "-D", "NAME=va l'ue",
+     "-o", "w.o", "-L", "/lib", "-l", "m"],
+]
+
+
+@pytest.mark.parametrize("argv", INVOCATION_CASES,
+                         ids=[str(i) for i in range(len(INVOCATION_CASES))])
+@pytest.mark.parametrize("directives_only", [False, True])
+def test_remote_invocation_cross_client_identical(testtool, argv,
+                                                  directives_only):
+    py = remote_invocation(CompilerArgs.parse(argv), directives_only)
+    flags = ["-d"] if directives_only else []
+    (native,) = run_tool(testtool, "invocation", *flags, *argv)
+    assert native == py
+
+
+def test_blake2b_matches_hashlib(testtool, tmp_path):
+    for name, payload in [
+        ("empty", b""),
+        ("small", b"hello world\n"),
+        ("odd", bytes(range(256)) * 3 + b"x"),
+        # Cross the 128-byte block boundary and a >64KiB read loop.
+        ("big", b"\xab" * (1 << 16) + b"tail"),
+    ]:
+        p = tmp_path / name
+        p.write_bytes(payload)
+        (got,) = run_tool(testtool, "blake2b", str(p))
+        assert got == digest_file(p), name

@@ -68,6 +68,33 @@ def _compile_locally(compiler: str, args: CompilerArgs) -> int:
         return pass_through_to_program([compiler] + args.args)
 
 
+def remote_invocation(args: CompilerArgs, directives_only: bool) -> str:
+    """Arguments forwarded to the servant, as one shell-quoted string:
+    no -o (it picks its own), no dependency-generation or include paths
+    (already resolved by preprocessing — reference
+    compilation_saas.cc:57-64).
+
+    This string feeds the task digest and cache key, so it must be
+    byte-identical between this client and the native one
+    (native/client/ytpu-cxx.cc remote_invocation) — the cross-client
+    parity test in tests/test_native_client.py holds both to it.
+    shlex-quoting matters because the servant runs the command through
+    `sh -c`: args with spaces/metacharacters (-DMSG='a b') must survive
+    the round trip intact.
+    """
+    import shlex
+
+    remote_args = args.rewrite(
+        remove=["-c", "-include", "-imacros", "-isystem", "-iquote", "-I"],
+        remove_prefix=["-o", "-M", "-I", "-iquote", "-isystem", "-include",
+                       "-Wp,"],
+        keep_sources=False,
+    )
+    if directives_only:
+        remote_args += ["-fpreprocessed", "-fdirectives-only"]
+    return " ".join(shlex.quote(a) for a in remote_args)
+
+
 def entry(argv: List[str]) -> int:
     """argv: [invoked-name, compiler-args...].  When invoked via the
     `ytpu-cxx g++ ...` form, argv[0] is the real compiler name."""
@@ -97,23 +124,7 @@ def entry(argv: List[str]) -> int:
         log.debug("tiny TU; compiling locally")
         return _compile_locally(compiler, args)
 
-    # Arguments forwarded to the servant: no -o (it picks its own), no
-    # dependency-generation or include paths (already resolved by
-    # preprocessing — reference compilation_saas.cc:57-64).
-    remote_args = args.rewrite(
-        remove=["-c", "-include", "-imacros", "-isystem", "-iquote", "-I"],
-        remove_prefix=["-o", "-M", "-I", "-iquote", "-isystem", "-include",
-                       "-Wp,"],
-        keep_sources=False,
-    )
-    if rewritten.directives_only:
-        remote_args += ["-fpreprocessed", "-fdirectives-only"]
-    # shlex-quote each element: the servant runs the command through
-    # `sh -c`, so args with spaces/metacharacters (-DMSG='a b') must
-    # survive the round trip intact.
-    import shlex
-
-    invocation = " ".join(shlex.quote(a) for a in remote_args)
+    invocation = remote_invocation(args, rewritten.directives_only)
 
     source = args.sources[0]
     for attempt in range(_CLOUD_RETRIES):

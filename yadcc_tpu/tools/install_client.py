@@ -30,7 +30,25 @@ def _repo_root() -> Path:
 
 
 def _native_client() -> Path | None:
-    p = _repo_root() / "native" / "ytpu-cxx"
+    """Build (or reuse) the native client; None if no toolchain.
+
+    The binary is never committed to the repo — it is built on the
+    machine it will run on so it can't drift from the sources.
+    """
+    import subprocess
+
+    native_dir = _repo_root() / "native"
+    if not (native_dir / "Makefile").exists():
+        return None
+    try:
+        r = subprocess.run(["make", "-C", str(native_dir), "ytpu-cxx",
+                            "libytpufakeroot.so"], capture_output=True)
+    except FileNotFoundError:  # no `make` on this host
+        return None
+    if r.returncode != 0:
+        sys.stderr.write("native build failed; using the Python client\n")
+        return None
+    p = native_dir / "ytpu-cxx"
     return p if p.exists() else None
 
 
