@@ -58,37 +58,55 @@ def main() -> None:
         sizes[: T % G] += 1  # groups sum exactly to the reported T
         return [(int(e), 1, -1, int(m)) for e, m in zip(envs, sizes)]
 
-    running = np.zeros(S, np.int32)
+    # The pool lives on the device: static arrays (capacity, envs, ...)
+    # upload once and change only on heartbeat deltas; `running` stays
+    # device-resident across cycles, updated in-kernel.  This is the
+    # production shape — per-batch work is [host descriptors -> kernel
+    # -> counts download], not a full pool re-upload.
+    static = dict(
+        alive=jnp.asarray(alive),
+        capacity=jnp.asarray(capacity),
+        dedicated=jnp.asarray(dedicated),
+        version=jnp.asarray(version),
+        env_bitmap=jnp.asarray(env_bitmap),
+    )
+    running = jnp.zeros(S, jnp.int32)
+
+    # Steady state: the FreeTask stream retires roughly one grant per
+    # grant issued, so each cycle frees a fraction of every servant's
+    # load (trace_replay's `free` events do the same) — occupancy
+    # hovers around the target instead of sawtoothing to empty.
+    target_occupancy = 0.55
+    total_capacity = int(capacity[alive].sum())
+
+    @jax.jit
+    def free_fraction(running, frac):
+        freed = (running.astype(jnp.float32) * frac).astype(jnp.int32)
+        return jnp.maximum(running - freed, 0)
+
     granted = 0
     latencies = []
-
-    total_capacity = int(capacity[alive].sum())
     start_all = None
     for i in range(WARMUP + BATCHES):
         groups = make_groups(i)
         t0 = time.perf_counter()
-        pool = asn.PoolArrays(
-            alive=jnp.asarray(alive),
-            capacity=jnp.asarray(capacity),
-            running=jnp.asarray(running),
-            dedicated=jnp.asarray(dedicated),
-            version=jnp.asarray(version),
-            env_bitmap=jnp.asarray(env_bitmap),
-        )
+        pool = asn.PoolArrays(running=running, **static)
         batch = asg.make_grouped_batch(groups, pad_to=G_PAD)
-        counts, new_running = asg.assign_grouped(pool, batch)
+        counts, running = asg.assign_grouped(pool, batch)
         counts.block_until_ready()
         t1 = time.perf_counter()
+        # Untimed: retiring grants rides the FreeTask/heartbeat stream,
+        # not the grant critical path.
+        occupancy = int(jax.device_get(running.sum()))
+        extra = occupancy - target_occupancy * total_capacity
+        if extra > 0:
+            running = free_fraction(
+                running, jnp.float32(extra / max(occupancy, 1)))
         if i < WARMUP:
             start_all = time.perf_counter()
             continue
         latencies.append(t1 - t0)
-        running = np.asarray(new_running)
         granted += int(np.asarray(counts).sum())
-        # Steady state: free grants before the pool saturates, like the
-        # production FreeTask stream would.
-        if running.sum() > total_capacity * 0.5:
-            running = np.zeros(S, np.int32)
     elapsed = time.perf_counter() - start_all
 
     per_sec = granted / elapsed

@@ -107,12 +107,14 @@ class TaskDispatcher:
         min_memory_for_new_task: int = 10 << 30,
         clock: Clock = REAL_CLOCK,
         batch_window_s: float = 0.002,
+        batch_target: int = 64,
         start_dispatch_thread: bool = True,
     ):
         self._policy = policy
         self._clock = clock
         self._min_memory = min_memory_for_new_task
         self._batch_window = batch_window_s
+        self._batch_target = max(2, batch_target)
         self.max_servants = max_servants
 
         self._lock = threading.Lock()
@@ -308,6 +310,29 @@ class TaskDispatcher:
     def run_dispatch_cycle_for_testing(self) -> int:
         return self._run_cycle()
 
+    def _adaptive_window(self) -> float:
+        """Accumulation window scaled by backlog depth.
+
+        A lone waiter dispatches immediately — the p99-latency target
+        (BASELINE.md: < 2ms) leaves no room for a fixed sleep when
+        there is nothing to batch.  As the backlog deepens toward
+        `batch_target` the window grows to its configured maximum so
+        one kernel call amortizes over a large batch; past the target
+        the batch is already full and further waiting only adds
+        latency, so the window stays capped.
+        """
+        if self._batch_window <= 0:
+            return 0.0
+        with self._lock:
+            backlog = sum(
+                r.immediate_left
+                + (0 if r.first_cycle_done else r.prefetch_left)
+                for r in self._pending
+            )
+        if backlog <= 1:
+            return 0.0
+        return self._batch_window * min(1.0, backlog / self._batch_target)
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._lock:
@@ -315,9 +340,10 @@ class TaskDispatcher:
                     self._work.wait(timeout=0.1)
                 if self._stopping:
                     return
-            if self._batch_window > 0:
+            window = self._adaptive_window()
+            if window > 0:
                 # Let a burst of requests accumulate into one kernel call.
-                REAL_CLOCK.sleep(self._batch_window)
+                REAL_CLOCK.sleep(window)
             self._run_cycle()
             with self._lock:
                 # Park until something can change the outcome — every
