@@ -1,6 +1,6 @@
 """Salted Bloom filter over a numpy bit-array.
 
-Host-side twin of the device probe kernel in yadcc_tpu/ops/bloom.py: both
+Host-side twin of the device probe kernel in yadcc_tpu/ops/bloom_probe.py: both
 sides derive probe indices identically (uint32 double hashing from a
 salted xxhash64 fingerprint), so a filter built here can be shipped to
 the device (or to a remote daemon, zstd-compressed) and probed there
@@ -42,7 +42,7 @@ def key_fingerprints(keys: Iterable[str], salt: int) -> np.ndarray:
 def probe_indices(h1: int, h2: int, num_hashes: int, num_bits: int) -> np.ndarray:
     i = np.arange(num_hashes, dtype=np.uint32)
     # uint32 wrap-around then mod num_bits — the device kernel does the
-    # exact same arithmetic, keep in sync with ops/bloom.py.
+    # exact same arithmetic, keep in sync with ops/bloom_probe.py.
     return ((np.uint32(h1) + i * np.uint32(h2)) % np.uint32(num_bits)).astype(
         np.int64
     )

@@ -103,6 +103,9 @@ def daemon_start(args) -> None:
     )
     if args.temporary_dir:
         config.temporary_dir = args.temporary_dir
+    # A missing temp root otherwise surfaces much later as a cryptic
+    # FileNotFoundError when the servant prepares its first workspace.
+    os.makedirs(config.temporary_dir, exist_ok=True)
     removed = clean_stale_temp_dirs(config.temporary_dir)
     if removed:
         logger.info("removed %d stale temp dirs", removed)

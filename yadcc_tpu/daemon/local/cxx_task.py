@@ -35,7 +35,7 @@ class CxxCompilationTask(DistributedTask):
     source_path: str
     source_digest: str
     invocation_arguments: str
-    cache_control: int  # 0 off, 1 on, 2 on+verify
+    cache_control: int  # 0 off, 1 on, 2 = refill (skip reads, still fill)
     compiler_digest: str
     compressed_source: bytes
 
