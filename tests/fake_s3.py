@@ -1,0 +1,181 @@
+"""In-process S3-compatible fake server for backend tests.
+
+Implements just enough of the S3 REST surface for
+S3ObjectStoreBackend: GET/PUT/DELETE object and ListObjectsV2 with
+continuation-token pagination.  Verifies AWS SigV4 signatures by
+recomputing them with the shared secret through the SAME signing code
+the client uses (yadcc_tpu/cache/s3_backend.py sigv4_headers) — a
+signing bug cannot pass its own verification twice by accident because
+the canonical request is rebuilt from the raw wire data here.
+
+Fault injection: fail_next(n) makes the next n requests return 500,
+exercising the client's retry/backoff path.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Tuple
+
+from yadcc_tpu.cache.s3_backend import S3Config, sigv4_headers
+
+
+class FakeS3Server:
+    def __init__(self, bucket: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1", max_keys: int = 1000):
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.max_keys = max_keys
+        self.objects: Dict[str, bytes] = {}
+        self.lock = threading.Lock()
+        self.fail_remaining = 0
+        self.requests_seen = 0
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _deny(self, status: int, msg: str):
+                body = f"<Error><Message>{msg}</Message></Error>".encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _check_auth(self, body: bytes) -> bool:
+                auth = self.headers.get("Authorization", "")
+                amz_date = self.headers.get("x-amz-date", "")
+                payload_sha = self.headers.get("x-amz-content-sha256", "")
+                if not auth or not amz_date:
+                    self._deny(403, "missing auth")
+                    return False
+                if hashlib.sha256(body).hexdigest() != payload_sha:
+                    self._deny(400, "payload hash mismatch")
+                    return False
+                parsed = urllib.parse.urlparse(self.path)
+                query = sorted(urllib.parse.parse_qsl(
+                    parsed.query, keep_blank_values=True))
+                now = datetime.datetime.strptime(
+                    amz_date, "%Y%m%dT%H%M%SZ").replace(
+                        tzinfo=datetime.timezone.utc)
+                cfg = S3Config(
+                    endpoint=self.headers.get("Host", ""),
+                    bucket=fake.bucket, access_key=fake.access_key,
+                    secret_key=fake.secret_key, region=fake.region)
+                want = sigv4_headers(cfg, self.command, parsed.path,
+                                     query, payload_sha, now=now)
+                if want["Authorization"] != auth:
+                    self._deny(403, "signature mismatch")
+                    return False
+                return True
+
+            def _object_key(self) -> str:
+                parsed = urllib.parse.urlparse(self.path)
+                path = urllib.parse.unquote(parsed.path)
+                bucket_prefix = f"/{fake.bucket}/"
+                if path.startswith(bucket_prefix):
+                    return path[len(bucket_prefix):]
+                return ""
+
+            def _maybe_fail(self) -> bool:
+                with fake.lock:
+                    fake.requests_seen += 1
+                    if fake.fail_remaining > 0:
+                        fake.fail_remaining -= 1
+                        self._deny(500, "injected fault")
+                        return True
+                return False
+
+            def _respond(self, status: int, body: bytes = b""):
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                if self._maybe_fail() or not self._check_auth(b""):
+                    return
+                key = self._object_key()
+                if key:
+                    with fake.lock:
+                        data = fake.objects.get(key)
+                    if data is None:
+                        self._respond(404, b"<Error/>")
+                    else:
+                        self._respond(200, data)
+                    return
+                # ListObjectsV2
+                q = dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlparse(self.path).query,
+                    keep_blank_values=True))
+                prefix = q.get("prefix", "")
+                start = int(q.get("continuation-token", "0") or "0")
+                with fake.lock:
+                    keys = sorted(k for k in fake.objects
+                                  if k.startswith(prefix))
+                page = keys[start : start + fake.max_keys]
+                truncated = start + fake.max_keys < len(keys)
+                parts = ["<?xml version='1.0'?><ListBucketResult>"]
+                parts.append(f"<IsTruncated>{str(truncated).lower()}"
+                             "</IsTruncated>")
+                if truncated:
+                    parts.append(f"<NextContinuationToken>"
+                                 f"{start + fake.max_keys}"
+                                 f"</NextContinuationToken>")
+                for k in page:
+                    with fake.lock:
+                        size = len(fake.objects.get(k, b""))
+                    esc = (k.replace("&", "&amp;").replace("<", "&lt;")
+                           .replace(">", "&gt;"))
+                    parts.append(f"<Contents><Key>{esc}</Key>"
+                                 f"<Size>{size}</Size></Contents>")
+                parts.append("</ListBucketResult>")
+                self._respond(200, "".join(parts).encode())
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                if self._maybe_fail() or not self._check_auth(body):
+                    return
+                key = self._object_key()
+                with fake.lock:
+                    fake.objects[key] = body
+                self._respond(200)
+
+            def do_DELETE(self):
+                if self._maybe_fail() or not self._check_auth(b""):
+                    return
+                key = self._object_key()
+                with fake.lock:
+                    fake.objects.pop(key, None)
+                self._respond(204)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def fail_next(self, n: int):
+        with self.lock:
+            self.fail_remaining = n
+
+    def stored(self) -> List[Tuple[str, int]]:
+        with self.lock:
+            return sorted((k, len(v)) for k, v in self.objects.items())

