@@ -318,3 +318,127 @@ class TestCacheService:
             assert ei.value.status == api.cache.CACHE_STATUS_INVALID_ARGUMENT
         finally:
             csvc._MAX_ENTRY_BYTES = old
+
+    # -- server-side Bloom full-fetch pacing (reference
+    # cache_service_impl.cc:48-65,81-123) --------------------------------
+
+    def _fetch(self, peer, service, last_full, last_any):
+        ch = Channel(f"mock://cache@{peer}")
+        return ch.call(
+            "ytpu.CacheService", "FetchBloomFilter",
+            api.cache.FetchBloomFilterRequest(
+                token="user", seconds_since_last_full_fetch=last_full,
+                seconds_since_last_fetch=last_any),
+            api.cache.FetchBloomFilterResponse)
+
+    def test_inflated_age_claims_cannot_force_full_fetches(self, service):
+        peer = "10.1.1.1:999"
+        resp, att = self._fetch(peer, service, 0, 0)
+        assert not resp.incremental  # first contact: one full fetch
+        ch = Channel("mock://cache")
+        for i in range(10):
+            service.clock.advance(30)
+            ch.call("ytpu.CacheService", "PutEntry",
+                    api.cache.PutEntryRequest(token="servant", key=f"k{i}"),
+                    api.cache.PutEntryResponse, attachment=b"v")
+            # The client (buggy or malicious) claims enormous sync ages
+            # on every call, which round 1 turned into a ~4MB full
+            # fetch each time.  The server now tracks the sync age
+            # itself and serves the incremental span it knows.
+            resp, _ = self._fetch(peer, service, 7200, 7200)
+            assert resp.incremental
+            assert f"k{i}" in list(resp.newly_populated_keys)
+
+    def test_periodic_full_fetch_still_happens(self, service):
+        peer = "10.1.1.2:999"
+        resp, _ = self._fetch(peer, service, 0, 0)
+        assert not resp.incremental
+        # Honest incremental clients must still be resynced with a full
+        # filter once their jittered ~10min interval elapses.
+        saw_full_after = None
+        elapsed = 0
+        for _ in range(30):
+            service.clock.advance(30)
+            elapsed += 30
+            resp, _ = self._fetch(peer, service, elapsed, 30)
+            if not resp.incremental:
+                saw_full_after = elapsed
+                break
+        assert saw_full_after is not None, "no periodic full fetch in 15min"
+        assert saw_full_after >= 480  # jitter floor: 600-120s
+        assert saw_full_after <= 750  # jitter ceiling: 600+120s, 30s grid
+
+    def test_pacing_state_is_per_client(self, service):
+        resp, _ = self._fetch("10.2.0.1:1", service, 0, 0)
+        assert not resp.incremental
+        # A different daemon's first contact gets its own full fetch,
+        # regardless of the first client's pacing state.
+        resp, att = self._fetch("10.2.0.2:1", service, 0, 0)
+        assert not resp.incremental and att
+
+    def test_incremental_across_restart_has_no_sync_hole(self, service,
+                                                         tmp_path):
+        # A client keeps an incremental replica, the cache server
+        # restarts (losing its key deque and pacing table), new keys
+        # land, and the client then asks for its usual incremental
+        # update.  It must receive a FULL filter containing both pre-
+        # and post-restart keys — serving an incremental there would
+        # leave a silent hole for keys filled before the restart.
+        peer = "10.3.0.1:1"
+        ch = Channel("mock://cache")
+        ch.call("ytpu.CacheService", "PutEntry",
+                api.cache.PutEntryRequest(token="servant", key="pre-restart"),
+                api.cache.PutEntryResponse, attachment=b"1")
+        resp, att = self._fetch(peer, service, 0, 0)
+        assert not resp.incremental
+
+        clock2 = VirtualClock(service.clock.now() + 45)
+        svc2 = CacheService(
+            InMemoryCache(1 << 20),
+            DiskCacheEngine([ShardSpec(str(tmp_path / "l2"), 1 << 20)]),
+            user_tokens=TokenVerifier(["user"]),
+            servant_tokens=TokenVerifier(["servant"]),
+            clock=clock2,
+        )
+        svc2.clock = clock2
+        register_mock_server("cache2", svc2.spec())
+        try:
+            clock2.advance(5)
+            ch2 = Channel("mock://cache2")
+            ch2.call("ytpu.CacheService", "PutEntry",
+                     api.cache.PutEntryRequest(token="servant",
+                                               key="post-restart"),
+                     api.cache.PutEntryResponse, attachment=b"2")
+            ch2p = Channel(f"mock://cache2@{peer}")
+            resp, att = ch2p.call(
+                "ytpu.CacheService", "FetchBloomFilter",
+                api.cache.FetchBloomFilterRequest(
+                    token="user", seconds_since_last_full_fetch=50,
+                    seconds_since_last_fetch=50),
+                api.cache.FetchBloomFilterResponse)
+            assert not resp.incremental, \
+                "incremental across restart would hide pre-restart keys"
+            payload = compress.decompress(att)
+            salt = int.from_bytes(payload[:4], "little")
+            replica = SaltedBloomFilter.from_bytes(
+                payload[4:], resp.num_hashes, salt)
+            assert replica.may_contain("pre-restart")
+            assert replica.may_contain("post-restart")
+        finally:
+            unregister_mock_server("cache2")
+
+    def test_restarted_daemon_on_known_ip_gets_full_filter(self, service):
+        # Two daemons can share one IP (same host / NAT), and a daemon
+        # restart loses its replica.  A client claiming "I hold no
+        # filter" (seconds_since_last_full_fetch=0) must get a full
+        # fetch even when the server still tracks pacing state for
+        # that IP — an incremental delta against a base it doesn't
+        # have would leave its Bloom replica near-empty.
+        peer = "10.4.0.1:1"
+        resp, _ = self._fetch(peer, service, 0, 0)
+        assert not resp.incremental
+        service.clock.advance(60)
+        resp, _ = self._fetch(peer, service, 60, 60)
+        assert resp.incremental  # established client: pacing applies
+        resp, att = self._fetch(peer, service, 0, 0)  # fresh daemon, same ip
+        assert not resp.incremental and att

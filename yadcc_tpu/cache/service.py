@@ -32,10 +32,17 @@ SERVICE_NAME = "ytpu.CacheService"
 # Sync ages beyond this force a full filter fetch even if the deque
 # could technically serve the gap (staleness bound).
 _MAX_INCREMENTAL_AGE_S = 1800.0
-# Compensation margin added to the client-reported age so clock skew and
-# RPC latency can't open a sync hole.
+# Compensation margin added to the sync age so clock skew and RPC
+# latency can't open a sync hole.
 _INCREMENTAL_COMPENSATION_S = 10.0
 _MAX_ENTRY_BYTES = 128 << 20  # reference cache packet cap (entry.cc:27-28)
+# Full-filter fetches are ~4MB; each client gets one roughly every 10
+# minutes, jittered per client so a fleet doesn't synchronize
+# (reference cache_service_impl.cc:48-65).
+_FULL_FETCH_INTERVAL_S = 600.0
+_FULL_FETCH_JITTER_S = 120.0
+# Per-client sync records are dropped after this idle time.
+_CLIENT_STATE_TTL_S = 2 * _MAX_INCREMENTAL_AGE_S
 
 
 class CacheService:
@@ -53,9 +60,12 @@ class CacheService:
         self.bloom = BloomFilterGenerator(clock=clock)
         self._user_tokens = user_tokens
         self._servant_tokens = servant_tokens
+        self._clock = clock
         self._l2_hits = 0
         self._fills = 0
         self._lock = threading.Lock()
+        # client ip -> (last_fetch_time, last_full_fetch_time)
+        self._client_sync: dict[str, tuple[float, float]] = {}
         # Initial rebuild so restarts serve a filter that matches L2.
         self.rebuild_bloom_filter()
 
@@ -76,14 +86,60 @@ class CacheService:
 
     # -- handlers ----------------------------------------------------------
 
+    def _full_fetch_interval(self, client: str) -> float:
+        """Per-client jittered interval, stable across calls so each
+        client keeps its own phase instead of the fleet synchronizing."""
+        h = int.from_bytes(client.encode()[-8:] or b"\0", "little")
+        return _FULL_FETCH_INTERVAL_S + (h % int(2 * _FULL_FETCH_JITTER_S)
+                                         - _FULL_FETCH_JITTER_S)
+
     def FetchBloomFilter(self, req, attachment, ctx: RpcContext):
         if not self._user_tokens.verify(req.token):
             raise RpcError(api.cache.CACHE_STATUS_ACCESS_DENIED, "bad token")
         resp = api.cache.FetchBloomFilterResponse()
-        age = req.seconds_since_last_fetch
+        now = self._clock.now()
+        client = (ctx.peer or "?").rsplit(":", 1)[0]  # ip; ports churn
+
+        # The sync age is tracked server-side per client: the server
+        # knows when it last served this client, so a buggy or
+        # malicious client can't claim ages that force a ~4MB full
+        # fetch on every call (reference cache_service_impl.cc:81-123).
+        with self._lock:
+            for ip, st in list(self._client_sync.items()):
+                if now - st[0] > _CLIENT_STATE_TTL_S:
+                    del self._client_sync[ip]
+            state = self._client_sync.get(client)
+        claimed_age = req.seconds_since_last_fetch
+        if state is None:
+            # First contact since (re)start: client claims are the only
+            # information; anything non-incremental gets the full filter.
+            age = claimed_age if claimed_age > 0 else float("inf")
+            full_due = req.seconds_since_last_full_fetch <= 0
+            last_full = now - max(req.seconds_since_last_full_fetch, 0.0)
+        else:
+            last_fetch, last_full = state
+            server_age = now - last_fetch
+            age = max(server_age, claimed_age)
+            # seconds_since_last_full_fetch <= 0 means "I hold no base
+            # filter at all" (fresh daemon, or a restarted one reusing
+            # an IP we still track): an incremental delta against a
+            # base the client doesn't have would leave its replica
+            # near-empty until the next periodic full fetch.
+            full_due = (req.seconds_since_last_full_fetch <= 0
+                        or now - last_full
+                        >= self._full_fetch_interval(client))
+            if (not full_due and not self.bloom.can_serve_incremental(age)
+                    and self.bloom.can_serve_incremental(server_age)):
+                # The client claims an age the key deque can't cover,
+                # but the server served it recently enough that it can.
+                # Serve the server-tracked span: an inflated claim must
+                # not force a ~4MB full fetch per call, and any real gap
+                # is repaired (at worst) by the next due full fetch —
+                # Bloom staleness costs hit rate, never correctness.
+                age = server_age
+
         can_incremental = (
-            req.seconds_since_last_full_fetch > 0
-            and age > 0
+            not full_due
             and age <= _MAX_INCREMENTAL_AGE_S
             and self.bloom.can_serve_incremental(age)
         )
@@ -92,6 +148,8 @@ class CacheService:
             resp.newly_populated_keys.extend(
                 self.bloom.get_newly_populated_keys(
                     age + _INCREMENTAL_COMPENSATION_S))
+            with self._lock:
+                self._client_sync[client] = (now, last_full)
             return resp
         resp.incremental = False
         resp.num_hashes = self.bloom.num_hashes
@@ -100,6 +158,8 @@ class CacheService:
         ctx.response_attachment = compress.compress(
             self.bloom.salt.to_bytes(4, "little")
             + self.bloom.filter_bytes())
+        with self._lock:
+            self._client_sync[client] = (now, now)
         return resp
 
     def TryGetEntry(self, req, attachment, ctx: RpcContext):
