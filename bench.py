@@ -122,6 +122,8 @@ def main() -> None:
         "pool_size": S,
         "kernel": "grouped",
         "device": str(jax.devices()[0]),
+        # A CPU number must never masquerade as a TPU number.
+        "cpu_fallback": bool(os.environ.get("BENCH_FORCE_CPU")),
     }))
 
 

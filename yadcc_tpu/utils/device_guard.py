@@ -1,0 +1,79 @@
+"""Wedge-proof entry for standalone device tools.
+
+The accelerator tunnel in some environments can hang backend
+initialization indefinitely (jax.devices() blocks in PJRT client
+creation with no timeout).  Any standalone tool that may touch the
+device runs its measurement in a re-exec'd child under a watchdog:
+
+    def main(): ...            # the tool, unchanged
+    if __name__ == "__main__":
+        guard_device_entry(main)
+
+Parent behavior: re-exec `sys.argv` with a child marker; on watchdog
+timeout, kill the child and retry once with YTPU_FORCE_CPU=1 (labeled —
+a CPU fallback must never masquerade as a device number).  A child that
+*completes* with a non-zero exit propagates that exit unchanged: tool
+failures (e.g. trace_replay's policy-divergence exit) are not
+infrastructure failures and must not be retried into a different
+answer.  bench.py uses the same pattern with its own BENCH_* env knobs
+(kept for driver compatibility).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_CHILD_MARKER = "YTPU_DEVICE_GUARD_CHILD"
+
+
+def force_cpu_if_requested() -> bool:
+    """Child-side: apply the forced-CPU override before backend init.
+    Env vars alone don't work here — the interpreter may have imported
+    jax at startup with an accelerator platform preset."""
+    if os.environ.get("YTPU_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
+
+
+def running_forced_cpu() -> bool:
+    return bool(os.environ.get("YTPU_FORCE_CPU"))
+
+
+def guard_device_entry(main, *, module: str = "",
+                       timeout_env: str = "YTPU_DEVICE_TIMEOUT",
+                       default_timeout_s: int = 600) -> None:
+    """`module`: dotted name for tools launched via `python -m ...` —
+    re-exec'ing the file path directly would break relative imports."""
+    if os.environ.get(_CHILD_MARKER):
+        force_cpu_if_requested()
+        main()
+        return
+
+    argv = ([sys.executable, "-m", module, *sys.argv[1:]] if module
+            else [sys.executable, *sys.argv])
+    timeout = int(os.environ.get(timeout_env, default_timeout_s))
+    base_env = dict(os.environ, **{_CHILD_MARKER: "1"})
+    attempts = [base_env]
+    if not os.environ.get("YTPU_FORCE_CPU"):
+        attempts.append(dict(base_env, YTPU_FORCE_CPU="1"))
+    for env in attempts:
+        forced = bool(env.get("YTPU_FORCE_CPU"))
+        try:
+            r = subprocess.run(argv, env=env, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"device-guard: attempt {'(forced CPU) ' if forced else ''}"
+                f"timed out after {timeout}s\n")
+            continue
+        if forced and r.returncode == 0:
+            sys.stderr.write(
+                "device-guard: NOTE: result produced on forced CPU — "
+                "the accelerator was unavailable\n")
+        sys.exit(r.returncode)
+    sys.stderr.write("device-guard: no backend produced a result\n")
+    sys.exit(3)
