@@ -25,6 +25,21 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session")
+def native_build():
+    """Build the native client + test tool once per session; yields the
+    native/ directory.  Skips on hosts without a C++ toolchain."""
+    import pathlib
+    import subprocess
+
+    native = pathlib.Path(__file__).resolve().parent.parent / "native"
+    r = subprocess.run(["make", "-C", str(native), "ytpu-cxx",
+                        "ytpu-testtool"], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"native toolchain unavailable: {r.stderr[-400:]}")
+    return native
+
+
 @pytest.fixture
 def tmp_shard_dirs(tmp_path):
     a = tmp_path / "shard_a"

@@ -224,3 +224,54 @@ class TestDeviceBloomKernel:
             jnp.zeros_like(jnp.asarray(host.words)), jnp.asarray(fps),
             num_bits=4099, num_hashes=5)
         assert np.array_equal(np.asarray(dev), host.words)
+
+
+class TestShardedAssignAtScaleUnderChurn:
+    def test_s8192_churn_parity(self):
+        """SURVEY §7 'fixed-shape design under churn': the production
+        pool shape (8192 slots ~ the 5k-servant scenario padded to a
+        device-friendly power of two) sharded over the 8-device mesh,
+        with servants joining and dying between every dispatch step
+        (alive-mask flips, capacity changes, running resets on the
+        corpses).  Every step must agree exactly with the single-device
+        kernel — slot for slot, including which tasks were denied."""
+        mesh = pmesh.make_mesh(8)
+        rng = np.random.default_rng(42)
+        s, t, steps = 8192, 128, 4
+
+        pool_np = random_pool_np(rng, s)
+        fn = pmesh.sharded_assign_fn(mesh)
+
+        for step in range(steps):
+            tasks = random_tasks(rng, t, s, n_envs=256)
+            batch = asn.make_batch(
+                [x[0] for x in tasks],
+                [x[1] for x in tasks],
+                [x[2] for x in tasks],
+                pad_to=t,
+            )
+            pool = to_pool_arrays(pool_np)
+            want_picks, want_running = asn.assign_batch(pool, batch)
+
+            sharded_pool = pmesh.shard_pool(pool, mesh)
+            got_picks, got_running = fn(sharded_pool, batch)
+            assert np.array_equal(np.asarray(got_picks),
+                                  np.asarray(want_picks)), f"step {step}"
+            assert np.array_equal(np.asarray(got_running),
+                                  np.asarray(want_running)), f"step {step}"
+
+            # Churn between steps: ~2% of slots flip liveness (deaths
+            # reset their load — the scheduler drops a dead servant's
+            # grants to zombies), some survivors change capacity, and
+            # the surviving running state carries over.
+            pool_np["running"] = np.array(want_running)  # writable copy
+            flips = rng.random(s) < 0.02
+            pool_np["alive"] = pool_np["alive"] ^ flips
+            died = flips & ~pool_np["alive"]
+            pool_np["running"][died] = 0
+            recap = rng.random(s) < 0.01
+            pool_np["capacity"][recap] = rng.integers(
+                4, 64, int(recap.sum()))
+
+        # The churn must have actually exercised both directions.
+        assert pool_np["alive"].sum() not in (0, s)

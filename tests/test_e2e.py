@@ -201,3 +201,95 @@ class TestEndToEnd:
         assert rc == 0
         out = subprocess.run(["./h3bin"], capture_output=True, text=True)
         assert out.stdout.strip() == "hello from ytpu e2e"
+
+
+# ---------------------------------------------------------------------------
+# The same cluster driven through the NATIVE client binary
+# (native/client/ytpu-cxx.cc), built from source in CI.  Reference tests
+# its flare-free client against the daemon protocol the same way
+# (yadcc/client/cxx/compilation_saas_test.cc:28-72); here the daemon is
+# the real one, over real loopback HTTP.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def native_client(native_build):
+    return native_build / "ytpu-cxx"
+
+
+def run_native(binary, cluster, cwd, *args):
+    env = dict(os.environ,
+               YTPU_DAEMON_PORT=str(cluster.http.port),
+               YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD="1")
+    return subprocess.run([str(binary), "g++", *args], cwd=cwd, env=env,
+                          capture_output=True, text=True, timeout=120)
+
+
+class TestEndToEndNativeClient:
+    def test_native_remote_compile_links_and_runs(self, cluster, workdir,
+                                                  native_client):
+        (workdir / "nat.cc").write_text(SOURCE.replace("ytpu e2e",
+                                                       "native client"))
+        before = cluster.delegate.inspect()["stats"]["actually_run"]
+        r = run_native(native_client, cluster, workdir,
+                       "-O2", "-c", "nat.cc", "-o", "nat.o")
+        assert r.returncode == 0, r.stderr
+        assert (workdir / "nat.o").exists()
+        assert cluster.delegate.inspect()["stats"]["actually_run"] \
+            == before + 1
+        subprocess.run([GXX, "nat.o", "-o", "natbin"], cwd=workdir,
+                       check=True)
+        out = subprocess.run(["./natbin"], cwd=workdir,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "hello from native client"
+
+    def test_native_client_shares_cache_with_python_client(
+            self, cluster, workdir, native_client):
+        # The Python client compiles and fills the distributed cache;
+        # the native client then compiles the SAME source with the SAME
+        # args and must HIT that entry — the two clients must produce
+        # byte-identical invocation strings and cache keys (round-1
+        # advisor finding made them diverge).
+        rc = client_entry(["g++", "-O2", "-c", "hello.cc", "-o", "hcc.o"])
+        assert rc == 0
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                cluster.cache_service.inspect()["fills"] == 0:
+            time.sleep(0.1)
+        assert cluster.cache_service.inspect()["fills"] >= 1
+        cluster.cache_reader.sync_once()
+        before = cluster.delegate.inspect()["stats"]["hit_cache"]
+        r = run_native(native_client, cluster, workdir,
+                       "-O2", "-c", "hello.cc", "-o", "hnat.o")
+        assert r.returncode == 0, r.stderr
+        assert cluster.delegate.inspect()["stats"]["hit_cache"] \
+            == before + 1, "native client missed the python-filled entry"
+        subprocess.run([GXX, "hnat.o", "-o", "hnatbin"], cwd=workdir,
+                       check=True)
+        out = subprocess.run(["./hnatbin"], cwd=workdir,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "hello from ytpu e2e"
+
+    def test_native_compile_error_passes_through(self, cluster, workdir,
+                                                 native_client):
+        (workdir / "natbad.cc").write_text(
+            "#include <iostream>\nint main() { undeclared_fn(); }\n"
+            + "// padding so the TU clears the local-compile threshold\n"
+            * 400)
+        r = run_native(native_client, cluster, workdir,
+                       "-O2", "-c", "natbad.cc", "-o", "natbad.o")
+        assert r.returncode != 0
+        assert "undeclared_fn" in r.stderr  # compiler diagnostics surface
+        assert not (workdir / "natbad.o").exists()
+
+    def test_native_non_distributable_runs_locally(self, cluster, workdir,
+                                                   native_client):
+        (workdir / "n2.cc").write_text(SOURCE)
+        r = run_native(native_client, cluster, workdir,
+                       "-O2", "-c", "n2.cc", "-o", "n2.o")
+        assert r.returncode == 0, r.stderr
+        # Linking (no -c) must pass through to the local toolchain.
+        r = run_native(native_client, cluster, workdir, "n2.o", "-o", "n2bin")
+        assert r.returncode == 0, r.stderr
+        out = subprocess.run(["./n2bin"], cwd=workdir,
+                             capture_output=True, text=True)
+        assert out.stdout.strip() == "hello from ytpu e2e"

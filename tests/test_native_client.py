@@ -26,17 +26,11 @@ from yadcc_tpu.client.yadcc_cxx import remote_invocation
 from yadcc_tpu.common.hashing import digest_file
 
 REPO = Path(__file__).resolve().parent.parent
-NATIVE = REPO / "native"
 
 
 @pytest.fixture(scope="session")
-def testtool():
-    """Build the native tools once per test session."""
-    r = subprocess.run(["make", "-C", str(NATIVE), "ytpu-testtool"],
-                       capture_output=True, text=True)
-    if r.returncode != 0:
-        pytest.skip(f"native toolchain unavailable: {r.stderr[-500:]}")
-    return NATIVE / "ytpu-testtool"
+def testtool(native_build):
+    return native_build / "ytpu-testtool"
 
 
 def run_tool(tool: Path, *argv: str) -> list[str]:
