@@ -49,3 +49,44 @@ class TestPallasAssign:
         picks, running = pallas_assign_batch(pool, batch, interpret=True)
         assert (np.asarray(picks[2:]) == asn.NO_PICK).all()
         assert int(np.asarray(running).sum()) == 2
+
+    def test_parity_at_production_shape(self):
+        """VERDICT round-1 item 4: the S=8192/T=512 parity check the
+        native-TPU A/B uses, here in interpret mode (identical kernel
+        code path; the driver's chip run compiles the same call
+        natively)."""
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops.pallas_assign import pallas_assign_batch
+
+        rng = np.random.default_rng(11)
+        s, t = 8192, 512
+        # Contended on purpose: tiny capacities, mostly-loaded pool,
+        # sparse environments — a real mix of grants and denials, so
+        # the infeasible/denial branch is exercised at scale too.
+        capacity = rng.integers(1, 4, s).astype(np.int32)
+        running0 = np.minimum(rng.integers(0, 4, s), capacity).astype(
+            np.int32)
+        # Only envs 0-127 exist in the pool; requests draw from 0-255,
+        # so about half hit an env no servant serves and MUST be denied.
+        env_density = rng.random((s, 8, 32)) < 0.02
+        env_density[:, 4:, :] = False
+        env_words = np.zeros((s, 8), np.uint32)
+        for b in range(32):
+            env_words |= env_density[:, :, b].astype(np.uint32) << b
+        pool = asn.PoolArrays(
+            alive=jnp.asarray(rng.random(s) < 0.9),
+            capacity=jnp.asarray(capacity),
+            running=jnp.asarray(running0),
+            dedicated=jnp.asarray(rng.random(s) < 0.3),
+            version=jnp.ones(s, jnp.int32),
+            env_bitmap=jnp.asarray(env_words),
+        )
+        batch = asn.make_batch(list(rng.integers(0, 256, t)), [1] * t,
+                               [-1] * t, pad_to=t)
+        got_p, got_r = pallas_assign_batch(pool, batch, interpret=True)
+        want_p, want_r = asn.assign_batch(pool, batch)
+        assert np.array_equal(np.asarray(got_p), np.asarray(want_p))
+        assert np.array_equal(np.asarray(got_r), np.asarray(want_r))
+        denied = int((np.asarray(got_p) == asn.NO_PICK).sum())
+        assert 0 < denied < t, f"need grants AND denials, got {denied}/{t}"

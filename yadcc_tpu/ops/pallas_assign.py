@@ -59,7 +59,10 @@ def _kernel_body(cm: DispatchCostModel):
 
         env_word = env_word_ref[t]
         env_bit = env_bit_ref[t]
-        word = env_bitmap_ref[:, env_word]
+        # env_bitmap arrives transposed (e_words, S): the dynamic word
+        # index lands on the leading (sublane) axis, the one dimension
+        # Mosaic reliably supports dynamic slicing on.
+        word = env_bitmap_ref[pl.dslice(env_word, 1), :][0]
         has_env = (word >> env_bit.astype(jnp.uint32)) & jnp.uint32(1)
 
         eligible = (
@@ -77,11 +80,18 @@ def _kernel_body(cm: DispatchCostModel):
         score = jnp.where(preferred, util_q - cm.preference_bonus_q, util_q)
         score = jnp.where(feasible, score, cm.infeasible_score_q)
 
+        # Mosaic-friendly forms only: the score at the argmin IS the
+        # min (no dynamic scalar gather), the capacity decrement is a
+        # one-hot vector add (no dynamic scalar scatter), and the pick
+        # lands in a per-step (1,)-block of the output (no dynamic
+        # store) — dynamic scalar indexing into VMEM is exactly the
+        # class of op that works interpreted but fails TPU lowering.
         pick = jnp.argmin(score).astype(jnp.int32)
-        granted = (score[pick] < cm.infeasible_score_q) & (valid_ref[t] != 0)
-        picks_ref[t] = jnp.where(granted, pick, NO_PICK)
-        running_scratch[pick] = running_scratch[pick] + granted.astype(
-            jnp.int32)
+        granted = (jnp.min(score) < cm.infeasible_score_q) & (
+            valid_ref[t] != 0)
+        picks_ref[0] = jnp.where(granted, pick, NO_PICK)
+        running_scratch[:] = running + jnp.where(
+            (slots == pick) & granted, 1, 0).astype(jnp.int32)
 
         @pl.when(t == pl.num_programs(0) - 1)
         def _():
@@ -118,8 +128,12 @@ def pallas_assign_batch(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # env_bitmap
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # picks
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # running_out
+            # One (1,)-element block per grid step: the kernel writes
+            # picks_ref[0], never a dynamically-indexed position.
+            pl.BlockSpec((1,), lambda i, *_: (i,),
+                         memory_space=pltpu.VMEM),  # picks
+            pl.BlockSpec((s,), lambda i, *_: (0,),
+                         memory_space=pltpu.VMEM),  # running_out
         ],
         scratch_shapes=[pltpu.VMEM((s,), jnp.int32)],
     )
@@ -144,6 +158,6 @@ def pallas_assign_batch(
         pool.running,
         pool.dedicated.astype(jnp.int32),
         pool.version,
-        pool.env_bitmap,
+        pool.env_bitmap.T,
     )
     return picks, running

@@ -201,10 +201,18 @@ class JaxGroupedPolicy(DispatchPolicy):
                 _upload_pool(snap, running), batch, self._cm)
             counts = np.asarray(counts)
             running = np.asarray(new_running)
+            # Expand (group, slot)->count into per-request picks with
+            # one pass over the counts matrix for the whole chunk
+            # (np.nonzero yields row-major order, i.e. grouped by
+            # group) — not a fresh S-sized arange per group.
+            grp, slot = np.nonzero(counts)
+            expanded = np.repeat(slot, counts[grp, slot])
+            offsets = np.concatenate(
+                ([0], np.cumsum(counts.sum(axis=1))))
             for ci, (_, member_idx) in enumerate(chunk):
-                slots = np.repeat(np.arange(len(snap.alive)), counts[ci])
-                for req_idx, slot in zip(member_idx, slots):
-                    picks[req_idx] = int(slot)
+                for req_idx, s in zip(
+                        member_idx, expanded[offsets[ci]:offsets[ci + 1]]):
+                    picks[req_idx] = int(s)
         return picks
 
 

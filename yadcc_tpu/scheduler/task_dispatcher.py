@@ -125,6 +125,9 @@ class TaskDispatcher:
         self._slots: List[Optional[_Servant]] = [None] * max_servants
         self._free_slots = list(range(max_servants - 1, -1, -1))
         self._by_location: Dict[str, int] = {}
+        # ip -> slots on that machine: requestor self-avoidance lookups
+        # happen per grant request and must not scan 5k locations.
+        self._by_ip: Dict[str, set] = {}
 
         self._grants: Dict[int, _Grant] = {}
         self._next_grant_id = 1
@@ -165,6 +168,8 @@ class TaskDispatcher:
                 slot = self._free_slots.pop()
                 self._slots[slot] = _Servant(slot=slot, info=info)
                 self._by_location[info.location] = slot
+                ip = info.location.rsplit(":", 1)[0]
+                self._by_ip.setdefault(ip, set()).add(slot)
             servant = self._slots[slot]
             servant.info = info
             servant.expires_at = self._clock.now() + expires_in_s
@@ -435,11 +440,8 @@ class TaskDispatcher:
         slot = self._by_location.get(requestor)
         if slot is not None:
             return slot
-        ip = requestor.rsplit(":", 1)[0]
-        for location, slot in self._by_location.items():
-            if location.rsplit(":", 1)[0] == ip:
-                return slot
-        return -1
+        slots = self._by_ip.get(requestor.rsplit(":", 1)[0])
+        return min(slots) if slots else -1
 
     def _expire_pending_locked(self, now: float) -> None:
         still = []
@@ -502,6 +504,12 @@ class TaskDispatcher:
             if g is not None:
                 servant.running_grants.discard(gid)
         del self._by_location[servant.info.location]
+        ip = servant.info.location.rsplit(":", 1)[0]
+        slots = self._by_ip.get(ip)
+        if slots is not None:
+            slots.discard(slot)
+            if not slots:
+                del self._by_ip[ip]
         self._slots[slot] = None
         self._free_slots.append(slot)
 
