@@ -270,3 +270,16 @@ class TestInspectAuth:
         assert not auth.check("Basic " + base64.b64encode(b"u:x").decode())
         assert not auth.check(None)
         assert not auth.check("Bearer xyz")
+
+
+def test_bloom_bench_run_smoke():
+    """BASELINE configs[3] harness: tiny-size run must produce the
+    sweep structure and agree with the host filter."""
+    from yadcc_tpu.tools.bloom_bench import run
+
+    out = run(n_keys=2000, populated=500)
+    assert len(out["sweep"]) == 3
+    for s in out["sweep"]:
+        # Observed positive rate ~ requested hit rate (+ FP noise).
+        assert s["observed_positive_rate"] >= s["hit_rate"] - 0.01
+        assert s["keys_per_sec"] > 0
