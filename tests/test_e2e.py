@@ -16,32 +16,9 @@ import time
 
 import pytest
 
-from yadcc_tpu.cache.disk_engine import DiskCacheEngine
-from yadcc_tpu.cache.in_memory_cache import InMemoryCache
-from yadcc_tpu.cache.service import CacheService
 from yadcc_tpu.client import daemon_call
 from yadcc_tpu.client.yadcc_cxx import entry as client_entry
-from yadcc_tpu.common.disk_cache import ShardSpec
-from yadcc_tpu.daemon.cloud.compiler_registry import CompilerRegistry
-from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
-from yadcc_tpu.daemon.cloud.distributed_cache_writer import \
-    DistributedCacheWriter
-from yadcc_tpu.daemon.cloud.execution_engine import ExecutionEngine
-from yadcc_tpu.daemon.config import DaemonConfig
-from yadcc_tpu.daemon.local.config_keeper import ConfigKeeper
-from yadcc_tpu.daemon.local.distributed_cache_reader import \
-    DistributedCacheReader
-from yadcc_tpu.daemon.local.distributed_task_dispatcher import \
-    DistributedTaskDispatcher
-from yadcc_tpu.daemon.local.file_digest_cache import FileDigestCache
-from yadcc_tpu.daemon.local.http_service import LocalHttpService
-from yadcc_tpu.daemon.local.local_task_monitor import LocalTaskMonitor
-from yadcc_tpu.daemon.local.task_grant_keeper import TaskGrantKeeper
-from yadcc_tpu.models.cost import DispatchCostModel
-from yadcc_tpu.rpc import GrpcServer
-from yadcc_tpu.scheduler.policy import GreedyCpuPolicy
-from yadcc_tpu.scheduler.service import SchedulerService
-from yadcc_tpu.scheduler.task_dispatcher import TaskDispatcher
+from yadcc_tpu.testing import LocalCluster
 
 GXX = shutil.which("g++")
 
@@ -56,90 +33,10 @@ int main() {
 """
 
 
-class Cluster:
-    """The three server programs in one process, on ephemeral ports."""
-
-    def __init__(self, tmp: pathlib.Path):
-        # Single-machine rig: self-avoidance must be off, or the only
-        # servant (ourselves) is never eligible.
-        policy = GreedyCpuPolicy(DispatchCostModel(avoid_self=False))
-        self.sched_dispatcher = TaskDispatcher(
-            policy, max_servants=16, max_envs=64, batch_window_s=0.0)
-        self.sched = SchedulerService(self.sched_dispatcher)
-        self.sched_server = GrpcServer("127.0.0.1:0")
-        self.sched_server.add_service(self.sched.spec())
-        self.sched_server.start()
-        sched_uri = f"grpc://127.0.0.1:{self.sched_server.port}"
-
-        self.cache_service = CacheService(
-            InMemoryCache(64 << 20),
-            DiskCacheEngine([ShardSpec(str(tmp / "l2"), 1 << 30)]))
-        self.cache_server = GrpcServer("127.0.0.1:0")
-        self.cache_server.add_service(self.cache_service.spec())
-        self.cache_server.start()
-        cache_uri = f"grpc://127.0.0.1:{self.cache_server.port}"
-
-        # Daemon, assembled the way daemon.entry does.
-        self.servant_server = GrpcServer("127.0.0.1:0")
-        config = DaemonConfig(
-            scheduler_uri=sched_uri,
-            cache_server_uri=cache_uri,
-            temporary_dir=str(tmp / "shm"),
-            location=f"127.0.0.1:{self.servant_server.port}",
-        )
-        (tmp / "shm").mkdir()
-        self.registry = CompilerRegistry()
-        self.engine = ExecutionEngine(max_concurrency=4,
-                                      min_memory_for_new_task=1)
-        self.config_keeper = ConfigKeeper(sched_uri, "")
-        cache_writer = DistributedCacheWriter(
-            cache_uri, self.config_keeper.serving_daemon_token)
-        self.daemon_service = DaemonService(
-            config, engine=self.engine, registry=self.registry,
-            cache_writer=cache_writer, allow_poor_machine=True,
-            cgroup_present=False)
-        self.servant_server.add_service(self.daemon_service.spec())
-        self.servant_server.start()
-
-        self.cache_reader = DistributedCacheReader(cache_uri, "")
-        self.delegate = DistributedTaskDispatcher(
-            grant_keeper=TaskGrantKeeper(sched_uri, ""),
-            config_keeper=self.config_keeper,
-            cache_reader=self.cache_reader,
-        )
-        self.http = LocalHttpService(
-            monitor=LocalTaskMonitor(nprocs=8, pid_prober=lambda p: True),
-            digest_cache=FileDigestCache(),
-            dispatcher=self.delegate,
-            port=0,
-        )
-        self.config_keeper.start()
-        self.cache_reader.start()
-        self.daemon_service.start_heartbeat()
-        self.http.start()
-        # First heartbeat must land before grants can be issued.
-        deadline = time.time() + 10
-        while time.time() < deadline and \
-                not self.sched_dispatcher.inspect()["servants"]:
-            time.sleep(0.05)
-        assert self.sched_dispatcher.inspect()["servants"]
-
-    def stop(self):
-        self.daemon_service.stop_heartbeat(graceful_leave=False)
-        self.http.stop()
-        self.cache_reader.stop()
-        self.config_keeper.stop()
-        for s in (self.servant_server, self.cache_server,
-                  self.sched_server):
-            s.stop(grace=0)
-        self.engine.stop()
-        self.sched_dispatcher.stop()
-
-
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("e2e")
-    c = Cluster(tmp)
+    c = LocalCluster(tmp)
     yield c
     c.stop()
 

@@ -31,4 +31,3 @@ class TestTraceReplay:
         total = sum(len(e["requests"]) for e in events
                     if e["kind"] == "batch")
         assert total == 60
-

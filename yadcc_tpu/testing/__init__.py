@@ -1,0 +1,10 @@
+"""In-process cluster rig for tests and simulation tools.
+
+The analogue of the reference's flare/testing layer (RPC mocks, test
+mains): everything needed to boot a real scheduler + cache server +
+N servant daemons + one delegate inside a single process on ephemeral
+loopback ports.  Used by tests/test_e2e.py and
+yadcc_tpu/tools/cluster_sim.py.
+"""
+
+from .local_cluster import LocalCluster, make_fake_compiler  # noqa: F401

@@ -1,0 +1,158 @@
+"""Synthetic build sweep through the full control plane.
+
+The BASELINE configs[0]/[2] analogue that fits in one process: boots the
+REAL cluster (scheduler + cache server + N servant daemons + delegate,
+over real loopback gRPC) with a fake instant compiler, then pushes a
+synthetic build of `--tasks` translation units through the delegate's
+production pipeline — Bloom gate, cache read, duplicate-task join,
+grant acquisition, servant RPC, execution engine, async cache fill —
+and reports end-to-end task throughput and latency percentiles plus the
+hit/reuse/run breakdown.
+
+    python -m yadcc_tpu.tools.cluster_sim --tasks 2000 --servants 4
+
+Duplicate sources (--dup-rate) exercise the dedup/join path; a second
+pass over the same sources exercises the distributed cache.  Numbers
+scale with host cores (each "compile" is a real subprocess); the point
+is a reproducible end-to-end artifact, not a hardware claim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
+        policy: str, in_flight: int = 0, compile_s: float = 0.05) -> dict:
+    from ..common import compress
+    from ..common.hashing import digest_bytes, digest_file
+    from ..daemon.local.cxx_task import CxxCompilationTask
+    from ..testing import LocalCluster, make_fake_compiler
+
+    # NB: no "ytpu" in the path — CompilerRegistry treats paths
+    # containing the client-wrapper markers as wrappers and skips them.
+    tmp = Path(tempfile.mkdtemp(prefix="csim_"))
+    compiler = make_fake_compiler(str(tmp / "bin"), compile_s=compile_s)
+    compiler_digest = digest_file(compiler)
+    cluster = LocalCluster(
+        tmp, n_servants=servants, policy=policy,
+        servant_concurrency=concurrency,
+        compiler_dirs=[str(tmp / "bin")])
+
+    rng = np.random.default_rng(1)
+    n_unique = max(1, int(tasks * (1.0 - dup_rate)))
+    sources = [f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
+               for i in range(n_unique)]
+    picks = list(range(n_unique)) + list(
+        rng.integers(0, n_unique, tasks - n_unique))
+    # Interleave duplicates with their originals so some arrive while
+    # the original is still compiling (the join/ReferenceTask path),
+    # and some after (the cache path).
+    rng.shuffle(picks)
+
+    def make_task(i: int) -> CxxCompilationTask:
+        src = sources[picks[i]]
+        return CxxCompilationTask(
+            requestor_pid=1,
+            source_path=f"/src/tu{picks[i]}.cc",
+            source_digest=digest_bytes(src),
+            invocation_arguments="-O2",
+            cache_control=1,
+            compiler_digest=compiler_digest,
+            compressed_source=compress.compress(src),
+        )
+
+    # Like a build system's -j: keep some queuing pressure but don't
+    # oversubscribe the rig (each in-flight TU is a thread + RPCs).
+    if not in_flight:
+        in_flight = 2 * servants * concurrency
+    latencies = []
+    failures = []
+    lock = threading.Lock()
+    work = list(range(tasks))
+
+    def submit_and_wait(i: int):
+        t0 = time.perf_counter()
+        # The real client retries infrastructure failures (negative
+        # exit codes) up to 5 times before giving up — backpressure
+        # under load is expected, not fatal (reference
+        # yadcc-cxx.cc:191-248).
+        for _ in range(5):
+            tid = cluster.delegate.queue_task(make_task(i))
+            result = cluster.delegate.wait_for_task(tid, timeout_s=120.0)
+            cluster.delegate.free_task(tid)
+            if result is not None and result.exit_code >= 0:
+                break
+        dt = time.perf_counter() - t0
+        with lock:
+            if result is None or result.exit_code != 0:
+                failures.append(i)
+            else:
+                latencies.append(dt)
+
+    def worker():
+        while True:
+            with lock:
+                if not work:
+                    return
+                i = work.pop()
+            submit_and_wait(i)
+
+    try:
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(in_flight)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t_start
+
+        def pctl(q):
+            if not latencies:  # all-failed run: report, don't crash
+                return None
+            return round(float(np.percentile(
+                np.array(latencies) * 1000, q)), 1)
+
+        stats = cluster.delegate.inspect()["stats"]
+        return {
+            "tasks": tasks,
+            "servants": servants,
+            "servant_concurrency": concurrency,
+            "policy": policy,
+            "wall_seconds": round(wall, 2),
+            "tasks_per_sec": round(tasks / wall, 1),
+            "failures": len(failures),
+            "p50_latency_ms": pctl(50),
+            "p99_latency_ms": pctl(99),
+            "breakdown": {k: stats[k] for k in
+                          ("hit_cache", "reused", "actually_run",
+                           "failed")},
+        }
+    finally:
+        cluster.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("ytpu-cluster-sim")
+    ap.add_argument("--tasks", type=int, default=2000)
+    ap.add_argument("--servants", type=int, default=4)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--dup-rate", type=float, default=0.2)
+    ap.add_argument("--policy", default="greedy_cpu")
+    args = ap.parse_args()
+    print(json.dumps(run(args.tasks, args.servants, args.concurrency,
+                         args.dup_rate, args.policy), indent=2))
+
+
+if __name__ == "__main__":
+    from ..utils.device_guard import guard_device_entry
+
+    guard_device_entry(main, module="yadcc_tpu.tools.cluster_sim")
