@@ -275,3 +275,50 @@ class TestShardedAssignAtScaleUnderChurn:
 
         # The churn must have actually exercised both directions.
         assert pool_np["alive"].sum() not in (0, s)
+
+
+class TestShardedAssign2D:
+    """Two-level (hosts x chips) mesh: the multi-host deployment shape.
+    Chip-local argmins reduce over ICI, only per-host scalar winners
+    cross DCN (parallel/mesh.py sharded_assign_fn_2d)."""
+
+    def test_matches_single_device(self):
+        mesh = pmesh.make_mesh_2d(2, 4)
+        rng = np.random.default_rng(9)
+        s, t = 256, 64  # 32 slots per device
+        pool_np = random_pool_np(rng, s)
+        tasks = random_tasks(rng, t, s, n_envs=256)
+        pool = to_pool_arrays(pool_np)
+        batch = asn.make_batch(
+            [x[0] for x in tasks], [x[1] for x in tasks],
+            [x[2] for x in tasks], pad_to=t)
+        want_p, want_r = asn.assign_batch(pool, batch)
+
+        fn = pmesh.sharded_assign_fn_2d(mesh)
+        sp = pmesh.shard_pool_2d(pool, mesh)
+        got_p, got_r = fn(sp, batch)
+        assert np.array_equal(np.asarray(got_p), np.asarray(want_p))
+        assert np.array_equal(np.asarray(got_r), np.asarray(want_r))
+
+    def test_s8192_churn_parity_2d(self):
+        mesh = pmesh.make_mesh_2d(2, 4)
+        rng = np.random.default_rng(43)
+        s, t, steps = 8192, 128, 3
+        pool_np = random_pool_np(rng, s)
+        fn = pmesh.sharded_assign_fn_2d(mesh)
+        for step in range(steps):
+            tasks = random_tasks(rng, t, s, n_envs=256)
+            batch = asn.make_batch(
+                [x[0] for x in tasks], [x[1] for x in tasks],
+                [x[2] for x in tasks], pad_to=t)
+            pool = to_pool_arrays(pool_np)
+            want_p, want_r = asn.assign_batch(pool, batch)
+            got_p, got_r = fn(pmesh.shard_pool_2d(pool, mesh), batch)
+            assert np.array_equal(np.asarray(got_p),
+                                  np.asarray(want_p)), f"step {step}"
+            assert np.array_equal(np.asarray(got_r),
+                                  np.asarray(want_r)), f"step {step}"
+            pool_np["running"] = np.array(want_r)
+            flips = rng.random(s) < 0.02
+            pool_np["alive"] = pool_np["alive"] ^ flips
+            pool_np["running"][flips & ~pool_np["alive"]] = 0

@@ -333,3 +333,31 @@ class TestSchedulerService:
                           api.scheduler.GetRunningTasksResponse)
         assert len(resp.running_tasks) == 1
         assert resp.running_tasks[0].task_grant_id == 77
+
+
+def test_jax_sharded_policy_matches_oracle():
+    """The production-selectable sharded policy (--dispatch-policy
+    jax_sharded) over the 8-device CPU test mesh must agree with the
+    greedy oracle on a contended pool."""
+    import numpy as np
+
+    from yadcc_tpu.scheduler.policy import (AssignRequest, GreedyCpuPolicy,
+                                            JaxShardedPolicy, PoolSnapshot)
+
+    rng = np.random.default_rng(21)
+    s = 64  # divides over 8 devices
+    snap = PoolSnapshot(
+        alive=rng.random(s) < 0.9,
+        capacity=rng.integers(1, 8, s).astype(np.int32),
+        running=np.zeros(s, np.int32),
+        dedicated=rng.random(s) < 0.3,
+        version=np.ones(s, np.int32),
+        env_bitmap=np.full((s, 8), 0xFFFFFFFF, np.uint32),
+    )
+    reqs = [AssignRequest(int(rng.integers(0, 256)), 1, -1)
+            for _ in range(40)]
+    want = GreedyCpuPolicy().assign(
+        PoolSnapshot(**{k: getattr(snap, k).copy()
+                        for k in snap.__dataclass_fields__}), reqs)
+    got = JaxShardedPolicy(max_servants=s).assign(snap, reqs)
+    assert got == want

@@ -28,6 +28,9 @@ from ..models.cost import DEFAULT_COST_MODEL, DispatchCostModel
 from ..ops.assignment import NO_PICK, PoolArrays, TaskBatch, _scores
 
 WORKER_AXIS = "workers"
+# Two-level meshes name the cross-host axis separately: collectives
+# over HOST_AXIS ride DCN, collectives over WORKER_AXIS ride ICI.
+HOST_AXIS = "hosts"
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -37,10 +40,40 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (WORKER_AXIS,))
 
 
+def make_mesh_2d(n_hosts: int, chips_per_host: int) -> Mesh:
+    """(hosts, chips) mesh for multi-host deployments.
+
+    The servant axis shards over BOTH axes (hosts x chips slices of the
+    pool); reductions are arranged so the per-step argmin combines
+    chip-local results over ICI first (WORKER_AXIS) and only the
+    per-host winners cross DCN (HOST_AXIS) — one scalar pair per host
+    per step, the scaling-book recipe for keeping the slow hop thin.
+    """
+    devices = jax.devices()
+    need = n_hosts * chips_per_host
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_hosts, chips_per_host)
+    # The ICI/DCN claim only holds if each row stays within one physical
+    # host; a row spanning two hosts would push the per-step WORKER_AXIS
+    # reduction over DCN silently.  (CPU test meshes have a single
+    # process and always pass.)
+    for row in grid:
+        procs = {d.process_index for d in row}
+        if len(procs) > 1:
+            raise ValueError(
+                f"mesh row spans processes {sorted(procs)}: "
+                f"chips_per_host={chips_per_host} does not match the "
+                "real host topology (use jax.local_device_count())")
+    return Mesh(grid, (HOST_AXIS, WORKER_AXIS))
+
 def pool_sharding(mesh: Mesh) -> PoolArrays:
-    """NamedShardings for a PoolArrays pytree: servant axis sharded."""
-    row = NamedSharding(mesh, P(WORKER_AXIS))
-    mat = NamedSharding(mesh, P(WORKER_AXIS, None))
+    """NamedShardings for a PoolArrays pytree: the servant axis shards
+    over EVERY mesh axis (row-major), so one helper serves the 1-level
+    and 2-level meshes alike."""
+    axes = tuple(mesh.axis_names)
+    row = NamedSharding(mesh, P(axes))
+    mat = NamedSharding(mesh, P(axes, None))
     return PoolArrays(
         alive=row, capacity=row, running=row,
         dedicated=row, version=row, env_bitmap=mat,
@@ -52,25 +85,37 @@ def shard_pool(pool: PoolArrays, mesh: Mesh) -> PoolArrays:
     return jax.tree.map(jax.device_put, pool, sh)
 
 
+# 2-level callers read better with the explicit name.
+shard_pool_2d = shard_pool
+
+
 def sharded_assign_fn(mesh: Mesh,
                       cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
     """Build a jitted (pool, batch) -> (picks, running) callable with the
-    servant axis sharded over `mesh`.
+    servant axis sharded over ALL of `mesh`'s axes.
 
-    Inside the per-device body, each step scores the local pool slice,
-    reduces (score, global_slot) to the global best with two pmins (min
-    score, then min slot among score-ties for the oracle's deterministic
-    lowest-slot tie-break), and the owning device applies the capacity
-    decrement to its slice.
+    Inside the per-device body, each scan step scores the local pool
+    slice, then reduces (score, global_slot) to the global best
+    hierarchically: one pmin pair per mesh axis, innermost (fastest
+    interconnect) axis first.  On a (hosts, chips) mesh that means
+    chip-local argmins combine over ICI and only per-host scalar
+    winners cross DCN — two scalars per host per step, regardless of
+    pool size.  Tie-breaks stay exact: slot numbering is axis-major, so
+    the min slot among score-ties within each level composes to the
+    global lowest-slot winner the oracle requires.  The owning device
+    applies the capacity decrement to its slice.
     """
-    ndev = mesh.devices.size
+    axes = tuple(mesh.axis_names)
     cm = cost_model
+    big = jnp.int32(2**30)
 
     def body(pool: PoolArrays, batch: TaskBatch):
-        # Local shard: S_local rows of the global pool.
         s_local = pool.alive.shape[0]
-        my_dev = jax.lax.axis_index(WORKER_AXIS)
-        base = my_dev * s_local  # global slot of local row 0
+        # Linear device index, row-major over the mesh axes.
+        linear = jnp.int32(0)
+        for name in axes:
+            linear = linear * mesh.shape[name] + jax.lax.axis_index(name)
+        base = linear * s_local  # global slot of local row 0
 
         def step(running, task):
             env_id, min_version, requestor, valid = task
@@ -79,21 +124,23 @@ def sharded_assign_fn(mesh: Mesh,
                 requestor - base,
                 jnp.int32(-1),
             )
-            score = _scores(pool, running, env_id, min_version, local_req, cm)
+            score = _scores(pool, running, env_id, min_version, local_req,
+                            cm)
             lbest = jnp.argmin(score).astype(jnp.int32)
-            lscore = score[lbest]
-            gbest_score = jax.lax.pmin(lscore, WORKER_AXIS)
-            # Among devices tying on score, take the smallest global slot.
-            cand_slot = jnp.where(
-                lscore == gbest_score, base + lbest, jnp.int32(2**30)
-            )
-            gbest_slot = jax.lax.pmin(cand_slot, WORKER_AXIS)
-            granted = (gbest_score < cm.infeasible_score_q) & valid
-            mine = granted & (gbest_slot >= base) & (gbest_slot < base + s_local)
-            running = running.at[gbest_slot - base].add(
-                mine.astype(jnp.int32)
-            )
-            return running, jnp.where(granted, gbest_slot, NO_PICK)
+            best_score = score[lbest]
+            best_slot = base + lbest
+            for name in reversed(axes):  # innermost axis reduces first
+                axis_score = jax.lax.pmin(best_score, name)
+                cand = jnp.where(best_score == axis_score, best_slot, big)
+                best_slot = jax.lax.pmin(cand, name)
+                best_score = axis_score
+
+            granted = (best_score < cm.infeasible_score_q) & valid
+            mine = granted & (best_slot >= base) & (
+                best_slot < base + s_local)
+            running = running.at[best_slot - base].add(
+                mine.astype(jnp.int32))
+            return running, jnp.where(granted, best_slot, NO_PICK)
 
         running, picks = jax.lax.scan(
             step,
@@ -103,9 +150,8 @@ def sharded_assign_fn(mesh: Mesh,
         return picks, running
 
     pool_spec = PoolArrays(
-        alive=P(WORKER_AXIS), capacity=P(WORKER_AXIS), running=P(WORKER_AXIS),
-        dedicated=P(WORKER_AXIS), version=P(WORKER_AXIS),
-        env_bitmap=P(WORKER_AXIS, None),
+        alive=P(axes), capacity=P(axes), running=P(axes),
+        dedicated=P(axes), version=P(axes), env_bitmap=P(axes, None),
     )
     batch_spec = TaskBatch(env_id=P(), min_version=P(), requestor=P(),
                            valid=P())
@@ -113,10 +159,15 @@ def sharded_assign_fn(mesh: Mesh,
         body,
         mesh=mesh,
         in_specs=(pool_spec, batch_spec),
-        out_specs=(P(), P(WORKER_AXIS)),
+        out_specs=(P(), P(axes)),
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+# The 2-level entry point is the same implementation: the hierarchical
+# reduction above is driven by the mesh's axis list.
+sharded_assign_fn_2d = sharded_assign_fn
 
 
 def sharded_bloom_probe_fn(mesh: Mesh, *, num_bits: int, num_hashes: int):

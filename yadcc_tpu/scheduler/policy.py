@@ -135,17 +135,24 @@ class JaxBatchedPolicy(DispatchPolicy):
         running = snap.running.copy()
         for start in range(0, len(requests), self._max_batch):
             chunk = requests[start : start + self._max_batch]
-            pool = _upload_pool(snap, running)
+            pool = self._prepare_pool(snap, running)
             batch = asn.make_batch(
                 [r.env_id for r in chunk],
                 [r.min_version for r in chunk],
                 [r.requestor_slot for r in chunk],
                 pad_to=self._max_batch,
             )
-            picks, new_running = asn.assign_batch(pool, batch, self._cm)
+            picks, new_running = self._run_kernel(pool, batch)
             picks_all.extend(int(p) for p in np.asarray(picks[: len(chunk)]))
             running = np.asarray(new_running)
         return picks_all
+
+    # Hooks for subclasses sharing the chunk/pad/carry loop.
+    def _prepare_pool(self, snap, running):
+        return _upload_pool(snap, running)
+
+    def _run_kernel(self, pool, batch):
+        return asn.assign_batch(pool, batch, self._cm)
 
 
 def _upload_pool(snap: PoolSnapshot, running):
@@ -216,6 +223,37 @@ class JaxGroupedPolicy(DispatchPolicy):
         return picks
 
 
+class JaxShardedPolicy(JaxBatchedPolicy):
+    """assign_batch semantics with the servant axis sharded over ALL
+    attached devices (parallel/mesh.py): per-step argmins reduce with
+    pmin collectives over ICI.  On a single device this degenerates to
+    the plain kernel; on a pod slice the pool splits across chips —
+    the deployment shape for registries past one chip's comfort.
+    Parity at S=8192 under churn: tests/test_assignment.py."""
+
+    name = "jax_sharded"
+
+    def __init__(self, max_servants: int, max_batch: int = 256,
+                 cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
+        super().__init__(max_servants, max_batch, cost_model)
+        from ..parallel import mesh as pmesh
+
+        self._mesh = pmesh.make_mesh()
+        self._fn = pmesh.sharded_assign_fn(self._mesh, cost_model)
+        self._shard = pmesh.shard_pool
+        ndev = self._mesh.devices.size
+        if max_servants % ndev:
+            raise ValueError(
+                f"max_servants ({max_servants}) must divide evenly over "
+                f"{ndev} devices")
+
+    def _prepare_pool(self, snap, running):
+        return self._shard(_upload_pool(snap, running), self._mesh)
+
+    def _run_kernel(self, pool, batch):
+        return self._fn(pool, batch)
+
+
 class JaxPallasPolicy(JaxBatchedPolicy):
     """assign_batch semantics via the single-pallas-call kernel
     (ops/pallas_assign.py): pool state pinned in VMEM across the whole
@@ -224,28 +262,14 @@ class JaxPallasPolicy(JaxBatchedPolicy):
 
     name = "jax_pallas"
 
-    def assign(self, snap, requests):
+    def _run_kernel(self, pool, batch):
         import jax
 
         from ..ops.pallas_assign import pallas_assign_batch
 
         interpret = jax.devices()[0].platform != "tpu"
-        picks_all: List[int] = []
-        running = snap.running.copy()
-        for start in range(0, len(requests), self._max_batch):
-            chunk = requests[start : start + self._max_batch]
-            pool = _upload_pool(snap, running)
-            batch = asn.make_batch(
-                [r.env_id for r in chunk],
-                [r.min_version for r in chunk],
-                [r.requestor_slot for r in chunk],
-                pad_to=self._max_batch,
-            )
-            picks, new_running = pallas_assign_batch(
-                pool, batch, self._cm, interpret=interpret)
-            picks_all.extend(int(p) for p in np.asarray(picks[: len(chunk)]))
-            running = np.asarray(new_running)
-        return picks_all
+        return pallas_assign_batch(pool, batch, self._cm,
+                                   interpret=interpret)
 
 
 def make_policy(name: str, max_servants: int,
@@ -261,4 +285,6 @@ def make_policy(name: str, max_servants: int,
         return JaxGroupedPolicy(cost_model=cm)
     if name == "jax_pallas":
         return JaxPallasPolicy(max_servants, cost_model=cm)
+    if name == "jax_sharded":
+        return JaxShardedPolicy(max_servants, cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")
