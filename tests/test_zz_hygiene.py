@@ -1,0 +1,31 @@
+"""Session-end hygiene sentinel (collected last by name).
+
+The reference enforces strict heap-leak checking on every test
+(BLADE_ROOT:25-33).  The Python analogue for a process-spawning,
+thread-heavy suite: after everything else ran, no test may have leaked
+a live subprocess of ours, and every surviving thread must be a daemon
+(a non-daemon leftover would hang interpreter exit — exactly the class
+of bug the engine/dispatcher stop() paths exist to prevent).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+
+
+def test_no_leaked_subprocesses():
+    # Our tests spawn `sleep 30` (stress), fake compilers, and real
+    # g++; anything still alive now escaped a stop()/kill path.
+    out = subprocess.run(
+        ["pgrep", "-fa", "sleep 30|fake|output.o"],
+        capture_output=True, text=True).stdout
+    leaked = [l for l in out.splitlines()
+              if "pgrep" not in l and l.strip()]
+    assert not leaked, f"processes outlived their tests: {leaked}"
+
+
+def test_no_nondaemon_thread_leaks():
+    stray = [t for t in threading.enumerate()
+             if t is not threading.main_thread() and not t.daemon]
+    assert not stray, f"non-daemon threads leaked: {stray}"
