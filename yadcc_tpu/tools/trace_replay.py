@@ -117,12 +117,21 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
     events = _load(path)
     assert events and events[0]["kind"] == "pool", "trace must open with pool"
     if policies is None:
+        from ..scheduler.policy import JaxShardedPolicy
+
+        s = len(events[0]["servants"])
         policies = {
             "greedy_cpu": GreedyCpuPolicy(),
-            "jax_batched": JaxBatchedPolicy(
-                max_servants=len(events[0]["servants"])),
+            "jax_batched": JaxBatchedPolicy(max_servants=s),
             "jax_grouped": JaxGroupedPolicy(),
         }
+        try:
+            # Requires S to divide over the attached devices; on a
+            # single chip this is the plain kernel through the mesh
+            # path (still worth A/B-ing: shard_map overhead shows).
+            policies["jax_sharded"] = JaxShardedPolicy(max_servants=s)
+        except ValueError:
+            pass
 
     results = {}
     reference_outcomes = None
