@@ -12,7 +12,9 @@ class TestTraceReplay:
         trace_replay.generate_trace(path, tasks=400, servants=48,
                                     batch=50, envs=8, seed=3)
         results = trace_replay.replay(path)
-        assert set(results) == {"greedy_cpu", "jax_batched", "jax_grouped"}
+        # jax_sharded joins the panel when 48 slots divide over the
+        # attached devices (they do on the 8-device CPU test mesh).
+        assert {"greedy_cpu", "jax_batched", "jax_grouped"} <= set(results)
         grants = {r["granted"] for r in results.values()}
         assert len(grants) == 1 and grants.pop() > 0
         assert all(r["matches_reference"] for r in results.values())
