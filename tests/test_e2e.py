@@ -190,3 +190,33 @@ class TestEndToEndNativeClient:
         out = subprocess.run(["./n2bin"], cwd=workdir,
                              capture_output=True, text=True)
         assert out.stdout.strip() == "hello from ytpu e2e"
+
+
+class TestDebugPathPatching:
+    """-g builds embed source/workspace paths in the object; the servant
+    compiles in a padded workspace and reports patch locations, and the
+    client must rewrite them so the debug info points at CLIENT paths
+    (reference remote_task/cxx_compilation_task.cc:78-140 — the
+    --coverage/debug-build story).  Checked for both clients."""
+
+    def _assert_patched(self, workdir, obj):
+        data = (workdir / obj).read_bytes()
+        assert b"cxx_" not in data, \
+            "servant workspace path leaked into debug info"
+        # The client-side absolute source dir must appear instead.
+        assert str(workdir).encode() in data
+
+    def test_python_client_patches_debug_paths(self, cluster, workdir):
+        (workdir / "dbg.cc").write_text(SOURCE)
+        rc = client_entry(["g++", "-g", "-O0", "-c", "dbg.cc",
+                           "-o", "dbg.o"])
+        assert rc == 0
+        self._assert_patched(workdir, "dbg.o")
+
+    def test_native_client_patches_debug_paths(self, cluster, workdir,
+                                               native_client):
+        (workdir / "dbgn.cc").write_text(SOURCE.replace("e2e", "native"))
+        r = run_native(native_client, cluster, workdir,
+                       "-g", "-O0", "-c", "dbgn.cc", "-o", "dbgn.o")
+        assert r.returncode == 0, r.stderr
+        self._assert_patched(workdir, "dbgn.o")

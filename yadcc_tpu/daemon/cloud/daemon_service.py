@@ -166,6 +166,13 @@ class DaemonService:
             digest=task.task_digest,
             cmdline=task.cmdline,
             on_completion=on_completion,
+            # Compile INSIDE the padded workspace: -g builds then embed
+            # it as DW_AT_comp_dir, which patch-location discovery finds
+            # and the client rewrites to its own directory — debuggers
+            # on the client machine resolve relative source names
+            # (reference pads the workspace for exactly this,
+            # remote_task/cxx_compilation_task.cc:78-92).
+            cwd=task.workspace.path,
         )
         if task_id is None:
             task.workspace.remove()

@@ -31,9 +31,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8336)
     p.add_argument("--inspect-port", type=int, default=9336)
     p.add_argument("--inspect-credential", default="")
-    p.add_argument("--dispatch-policy", default="greedy_cpu",
-                   choices=["greedy_cpu", "jax_batched", "jax_grouped",
-                            "jax_pallas", "jax_sharded"])
+    p.add_argument("--dispatch-policy", default="auto",
+                   choices=["auto", "greedy_cpu", "jax_batched",
+                            "jax_grouped", "jax_pallas", "jax_sharded"],
+                   help="auto = host greedy under 16 waiters, grouped "
+                        "device kernel above (the measured winner, "
+                        "artifacts/trace_ab.json)")
     p.add_argument("--max-servants", type=int, default=8192)
     p.add_argument("--min-daemon-version", type=int, default=0)
     p.add_argument("--acceptable-user-tokens", default="")

@@ -20,6 +20,9 @@ import numpy as np
 
 from ..models.cost import DEFAULT_COST_MODEL, DispatchCostModel
 from ..ops import assignment as asn
+from ..utils.logging import get_logger
+
+logger = get_logger("scheduler.policy")
 
 
 class EnvRegistry:
@@ -272,6 +275,39 @@ class JaxPallasPolicy(JaxBatchedPolicy):
                                    interpret=interpret)
 
 
+class AutoPolicy(DispatchPolicy):
+    """Backlog-adaptive hybrid: small micro-batches take the host greedy
+    path (no device round-trip — a lone request resolves in
+    microseconds), deep backlogs take the grouped device kernel (the
+    measured throughput winner, artifacts/trace_ab.json).  Outcome
+    equivalence between the two is enforced by the golden tests, so
+    switching is purely a latency/throughput trade."""
+
+    name = "auto"
+
+    def __init__(self,
+                 cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+                 device_threshold: int = 16):
+        self._greedy = GreedyCpuPolicy(cost_model)
+        self._grouped = JaxGroupedPolicy(cost_model=cost_model)
+        self._threshold = device_threshold
+        self._device_dead = False
+
+    def assign(self, snap, requests):
+        if self._device_dead or len(requests) < self._threshold:
+            return self._greedy.assign(snap, requests)
+        try:
+            return self._grouped.assign(snap, requests)
+        except Exception:
+            # A broken jax install or wedged accelerator must degrade
+            # to the host oracle, not take down grant dispatch — the
+            # outcomes are equivalent, only throughput differs.
+            logger.exception(
+                "device policy failed; pinning the greedy fallback")
+            self._device_dead = True
+            return self._greedy.assign(snap, requests)
+
+
 def make_policy(name: str, max_servants: int,
                 avoid_self: bool = True) -> DispatchPolicy:
     from dataclasses import replace
@@ -287,4 +323,6 @@ def make_policy(name: str, max_servants: int,
         return JaxPallasPolicy(max_servants, cost_model=cm)
     if name == "jax_sharded":
         return JaxShardedPolicy(max_servants, cost_model=cm)
+    if name == "auto":
+        return AutoPolicy(cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")

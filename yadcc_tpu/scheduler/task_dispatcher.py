@@ -349,7 +349,14 @@ class TaskDispatcher:
             if window > 0:
                 # Let a burst of requests accumulate into one kernel call.
                 REAL_CLOCK.sleep(window)
-            self._run_cycle()
+            try:
+                self._run_cycle()
+            except Exception:
+                # A policy bug must not kill the dispatch thread — that
+                # silently halts all granting forever.  Waiters retry
+                # on their own deadlines; log loudly and keep serving.
+                logger.exception("dispatch cycle failed; continuing")
+                REAL_CLOCK.sleep(0.05)
             with self._lock:
                 # Park until something can change the outcome — every
                 # state change (new request, free_task, heartbeat,
