@@ -220,3 +220,32 @@ class TestDebugPathPatching:
                        "-g", "-O0", "-c", "dbgn.cc", "-o", "dbgn.o")
         assert r.returncode == 0, r.stderr
         self._assert_patched(workdir, "dbgn.o")
+
+
+class TestDependencyFiles:
+    """-MD/-MF dependency files are produced during LOCAL preprocessing
+    (the -M* flags stay in the preprocess invocation and are stripped
+    from the remote one — reference compilation_saas.cc:57-64), so
+    dependency-tracking build systems keep working with remote
+    compiles."""
+
+    def test_md_dep_file_written_alongside_remote_compile(self, cluster,
+                                                          workdir):
+        (workdir / "dep.cc").write_text(SOURCE)
+        rc = client_entry(["g++", "-MD", "-MF", "dep.d", "-O2", "-c",
+                           "dep.cc", "-o", "dep.o"])
+        assert rc == 0
+        assert (workdir / "dep.o").exists()
+        dep = (workdir / "dep.d").read_text()
+        assert "dep.cc" in dep
+        assert "iostream" in dep  # real header closure, not a stub
+
+    def test_native_md_dep_file(self, cluster, workdir, native_client):
+        (workdir / "depn.cc").write_text(SOURCE)
+        r = run_native(native_client, cluster, workdir,
+                       "-MD", "-MF", "depn.d", "-O2", "-c", "depn.cc",
+                       "-o", "depn.o")
+        assert r.returncode == 0, r.stderr
+        assert (workdir / "depn.o").exists()
+        dep = (workdir / "depn.d").read_text()
+        assert "depn.cc" in dep and "iostream" in dep
