@@ -106,3 +106,45 @@ def test_cluster_sim_smoke():
     # stats may legitimately exceed the task count by the retry count.
     assert b["hit_cache"] + b["reused"] + b["actually_run"] >= 40
     assert out["tasks_per_sec"] > 0
+
+
+def test_servant_lost_mid_compile_fails_cleanly(tmp_path):
+    """Kill the only servant while it compiles: the delegate must
+    surface a daemon-synthesized failure (negative exit code — the
+    client's local-fallback trigger), not hang, and the scheduler must
+    expire the dead servant and release its capacity as zombies get
+    confirmed (reference failure-detection story, SURVEY §5)."""
+    compiler = make_fake_compiler(str(tmp_path / "bin"), compile_s=30.0)
+    cd = digest_file(compiler)
+    cluster = LocalCluster(tmp_path, n_servants=1, servant_concurrency=2,
+                           compiler_dirs=[str(tmp_path / "bin")])
+    try:
+        tid = cluster.delegate.queue_task(
+            make_task(cd, b"int doomed();", 0))
+        # Wait until the servant actually started executing.
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                cluster.servants[0].engine.inspect()["running"] == 0:
+            time.sleep(0.05)
+        assert cluster.servants[0].engine.inspect()["running"] == 1
+
+        # The machine "dies": RPC server gone, heartbeats stop.
+        cluster.servants[0].service.stop_heartbeat(graceful_leave=False)
+        cluster.servants[0].server.stop(grace=0)
+
+        result = cluster.delegate.wait_for_task(tid, timeout_s=60.0)
+        assert result is not None, "delegate hung on a dead servant"
+        assert result.exit_code < 0  # infrastructure failure, retryable
+        cluster.delegate.free_task(tid)
+
+        # The scheduler drops the servant once its lease lapses (10s).
+        deadline = time.time() + 20
+        while time.time() < deadline and \
+                cluster.sched_dispatcher.inspect()["servants"]:
+            cluster.sched_dispatcher.on_expiration_timer()
+            time.sleep(0.25)
+        assert not cluster.sched_dispatcher.inspect()["servants"]
+        assert cluster.sched_dispatcher.inspect()["grants_outstanding"] \
+            == 0, "dead servant's grant leaked"
+    finally:
+        cluster.stop()

@@ -62,7 +62,7 @@ class TestCacheFormat:
 
     def test_key_prefix(self):
         key = cache_format.get_cache_key("c", "-O2", "s")
-        assert key.startswith("ytpu-cxx1-entry-")
+        assert key.startswith("ytpu-cxx2-entry-")  # v2: digest covers meta too
 
 
 class TestPacking:

@@ -1,0 +1,89 @@
+"""Seeded mutation fuzz over the wire parsers.
+
+Every parser that consumes network bytes must treat arbitrary
+corruption as a clean miss/None — never an exception (a malformed
+frame would otherwise take down the handler thread; the reference gets
+this hardening from protobuf + its strict test tier).  Deterministic
+seeds keep failures reproducible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from yadcc_tpu.common import compress
+from yadcc_tpu.common.multi_chunk import (make_multi_chunk,
+                                          try_parse_multi_chunk)
+from yadcc_tpu.daemon.cache_format import (CacheEntry, try_parse_cache_entry,
+                                           write_cache_entry)
+
+ROUNDS = 300
+
+
+def _mutations(rng, data: bytes):
+    """Truncations, bit flips, splices, and garbage — the classic set."""
+    b = bytearray(data)
+    kind = rng.integers(0, 5)
+    if kind == 0 and b:
+        return bytes(b[: rng.integers(0, len(b))])        # truncate
+    if kind == 1 and b:
+        i = rng.integers(0, len(b))
+        b[i] ^= 1 << rng.integers(0, 8)                   # bit flip
+        return bytes(b)
+    if kind == 2:
+        i = rng.integers(0, len(b) + 1)
+        return bytes(b[:i]) + rng.bytes(rng.integers(1, 32)) + bytes(b[i:])
+    if kind == 3 and len(b) > 8:
+        return bytes(b[rng.integers(1, 8):])              # drop header
+    return rng.bytes(rng.integers(0, 200))                # pure garbage
+
+
+def test_multi_chunk_parser_never_raises():
+    rng = np.random.default_rng(0)
+    base = make_multi_chunk([b"json-part", b"\x00\x01payload" * 20])
+    for _ in range(ROUNDS):
+        mutated = _mutations(rng, base)
+        out = try_parse_multi_chunk(mutated)
+        assert out is None or isinstance(out, list)
+    # And the happy path still round-trips after all that.
+    assert try_parse_multi_chunk(base) == [b"json-part",
+                                           b"\x00\x01payload" * 20]
+
+
+def test_cache_entry_parser_never_raises():
+    rng = np.random.default_rng(1)
+    entry = write_cache_entry(CacheEntry(
+        exit_code=0, standard_output=b"", standard_error=b"warn\n",
+        files={".o": compress.compress(b"\x7fELF fake object")},
+        patches={".o": []},
+    ))
+    for _ in range(ROUNDS):
+        parsed = try_parse_cache_entry(_mutations(rng, entry))
+        assert parsed is None or parsed.exit_code == 0
+    assert try_parse_cache_entry(entry) is not None
+
+
+def test_decompress_never_raises():
+    rng = np.random.default_rng(2)
+    blob = compress.compress(b"x" * 4096)
+    for _ in range(ROUNDS):
+        out = compress.try_decompress(_mutations(rng, blob))
+        assert out is None or isinstance(out, bytes)
+
+
+def test_hostile_declared_content_size_rejected():
+    """A small frame declaring a huge decompressed size must be refused
+    BEFORE any allocation: python-zstandard's max_output_size does not
+    bind frames that declare a content size, so the cap is enforced on
+    the declared size itself."""
+    import zstandard
+
+    from yadcc_tpu.common.compress import decompress
+
+    big = zstandard.ZstdCompressor(level=1).compress(b"\x00" * (64 << 20))
+    assert len(big) < (1 << 20)  # tiny frame, 64MB declared
+    import pytest
+
+    with pytest.raises(zstandard.ZstdError):
+        decompress(big, max_output_size=1 << 20)
+    assert decompress(big, max_output_size=128 << 20) == b"\x00" * (64 << 20)

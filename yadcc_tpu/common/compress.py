@@ -35,16 +35,33 @@ def compress(data: bytes) -> bytes:
     return _ctx()[0].compress(data)
 
 
-def decompress(data: bytes, max_output_size: int = 1 << 31) -> bytes:
-    # Frames produced by streaming compressors have no content size in the
-    # header, so a cap is required.
+# Decompressed payloads beyond this are treated as corruption.  Wire
+# packets cap at 1GB compressed (reference daemon/entry.cc, sized for
+# Java jars); 2GB decompressed leaves headroom for zstd's typical ratios
+# on preprocessed C++ without letting a frame demand absurd allocations.
+_MAX_DECOMPRESSED = 1 << 31
+
+
+def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:
+    # max_output_size only binds STREAMING frames (no content size in
+    # the header) — python-zstandard ignores it when the frame declares
+    # a size, so a hostile 16KB frame declaring terabytes would attempt
+    # the full allocation (fuzz-found, tests/test_fuzz_parsers.py).
+    # Check the declared size ourselves before touching the allocator
+    # (-1 = streaming/unknown; raises on malformed headers).
+    declared = zstandard.frame_content_size(data)
+    if declared > max_output_size:
+        raise zstandard.ZstdError(
+            f"declared content size {declared} exceeds cap")
     return _ctx()[1].decompress(data, max_output_size=max_output_size)
 
 
 def try_decompress(data: bytes) -> Optional[bytes]:
     try:
         return decompress(data)
-    except zstandard.ZstdError:
+    except (zstandard.ZstdError, MemoryError, ValueError):
+        # Corruption — including allocation-level failures — must read
+        # as a miss, never take down the serving thread.
         return None
 
 

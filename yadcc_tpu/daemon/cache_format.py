@@ -3,10 +3,14 @@
 Parity with reference yadcc/daemon/cache_format.cc:35-127: an entry
 bundles the compiler's exit code, stdout/stderr, the produced output
 files (individually zstd-compressed) and their path-patch locations,
-with an integrity digest over the file payloads so a corrupted cache
-entry is detected instead of linking garbage into the user's build.
+with an integrity digest so a corrupted entry is detected instead of
+linking garbage into the user's build.  The digest covers the file
+payloads AND the meta fields (exit code, streams, patch offsets): a
+flipped patch offset corrupts the object just as surely as a flipped
+payload byte.
 
-Layout:  b"YTC1" + u32 meta_len + CacheMeta-JSON + multi_chunk(files)
+Layout:  b"YTC2" + u32 meta_len + CacheMeta-JSON + multi_chunk(files)
+where CacheMeta.entry_digest = digest(meta-sans-digest + body)
 
 Cache keys are derived from the task digest (reference :56-64), i.e.
 compiler + args + preprocessed source.
@@ -23,12 +27,12 @@ from ..common.hashing import digest_bytes
 from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
 from .task_digest import get_cxx_task_digest
 
-_MAGIC = b"YTC1"
+_MAGIC = b"YTC2"
 _LEN = struct.Struct("<I")
 
 # Bump the key prefix on any format change: old entries become silent
 # misses instead of parse failures (reference cache_format.cc:56-64).
-_KEY_PREFIX = "ytpu-cxx1-entry-"
+_KEY_PREFIX = "ytpu-cxx2-entry-"
 
 
 @dataclass
@@ -62,8 +66,11 @@ def write_cache_entry(entry: CacheEntry) -> bytes:
             k: [[p, t, s.hex()] for p, t, s in v]
             for k, v in entry.patches.items()
         },
-        "files_digest": digest_bytes(body),
     }
+    # Digest over the serialized meta (sort_keys: canonical form) plus
+    # the body, so every field is integrity-protected.
+    canonical = json.dumps(meta, sort_keys=True).encode()
+    meta["entry_digest"] = digest_bytes(canonical + body)
     meta_bytes = json.dumps(meta).encode()
     return _MAGIC + _LEN.pack(len(meta_bytes)) + meta_bytes + body
 
@@ -77,8 +84,10 @@ def try_parse_cache_entry(data: bytes) -> Optional[CacheEntry]:
         meta_end = 8 + meta_len
         meta = json.loads(data[8:meta_end])
         body = data[meta_end:]
-        if meta["files_digest"] != digest_bytes(body):
-            return None  # integrity failure
+        claimed = meta.pop("entry_digest")
+        canonical = json.dumps(meta, sort_keys=True).encode()
+        if claimed != digest_bytes(canonical + body):
+            return None  # integrity failure (meta or body tampered)
         chunks = try_parse_multi_chunk(body)
         if chunks is None or len(chunks) != len(meta["file_keys"]):
             return None
