@@ -148,3 +148,31 @@ def test_servant_lost_mid_compile_fails_cleanly(tmp_path):
             == 0, "dead servant's grant leaked"
     finally:
         cluster.stop()
+
+
+def test_rig_with_auto_policy_device_route(tmp_path):
+    """The production default (--dispatch-policy auto) through the full
+    RPC stack, with the device threshold forced to 1 so every dispatch
+    takes the grouped DEVICE kernel — the hybrid's device branch must
+    carry real grants, not just the greedy fallback."""
+    from dataclasses import replace
+
+    from yadcc_tpu.models.cost import DEFAULT_COST_MODEL
+    from yadcc_tpu.scheduler.policy import AutoPolicy
+
+    compiler = make_fake_compiler(str(tmp_path / "bin"))
+    cd = digest_file(compiler)
+    policy = AutoPolicy(cost_model=replace(DEFAULT_COST_MODEL,
+                                           avoid_self=False),
+                        device_threshold=1)
+    cluster = LocalCluster(tmp_path, n_servants=2, servant_concurrency=2,
+                           policy=policy,
+                           compiler_dirs=[str(tmp_path / "bin")])
+    try:
+        tids = [cluster.delegate.queue_task(
+            make_task(cd, f"int a{i}();".encode(), 0)) for i in range(6)]
+        results = [cluster.delegate.wait_for_task(t, 60) for t in tids]
+        assert all(r is not None and r.exit_code == 0 for r in results)
+        assert not policy._device_dead, "device route fell back"
+    finally:
+        cluster.stop()

@@ -70,7 +70,13 @@ class SaltedBloomFilter:
         if words is None:
             self._words = np.zeros(nwords, dtype=np.uint32)
         else:
-            assert words.shape == (nwords,)
+            # Explicit validation, not an assert: word arrays arrive
+            # from the network (filter replicas), and a truncated fetch
+            # must be a clean error even under `python -O`.
+            if words.shape != (nwords,):
+                raise ValueError(
+                    f"filter data holds {words.shape[0]} words, "
+                    f"{num_bits} bits needs {nwords}")
             self._words = words.astype(np.uint32, copy=False)
 
     # -- mutation ---------------------------------------------------------
@@ -115,6 +121,9 @@ class SaltedBloomFilter:
         salt: int,
         num_bits: int | None = None,
     ) -> "SaltedBloomFilter":
+        if len(data) % 4:
+            raise ValueError(f"filter data length {len(data)} is not "
+                             "a whole number of u32 words")
         words = np.frombuffer(data, dtype=np.uint32).copy()
         if num_bits is None:
             # The wire protocol doesn't carry num_bits (parity with the

@@ -129,9 +129,11 @@ class LocalCluster:
         http_port: int = 0,
     ):
         # Single-process rig: self-avoidance must be off, or the
-        # requesting machine (ourselves) is never eligible.
-        pol = make_policy(policy, max_servants=max(16, n_servants),
-                          avoid_self=False)
+        # requesting machine (ourselves) is never eligible.  `policy`
+        # is a name for make_policy, or a ready DispatchPolicy instance
+        # (tests injecting tuned thresholds / spies).
+        pol = policy if not isinstance(policy, str) else make_policy(
+            policy, max_servants=max(16, n_servants), avoid_self=False)
         self.sched_dispatcher = TaskDispatcher(
             pol, max_servants=max(16, n_servants), max_envs=64,
             batch_window_s=0.0)
