@@ -1,0 +1,167 @@
+"""Direct unit coverage for the small host utilities that everything
+else leans on (previously exercised only through integration paths):
+temp-dir hygiene, env knobs, fs helpers, the inspect server's auth
+gate, the installer, proto generation idempotency, privilege drop."""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestTempDir:
+    def test_stale_cleanup_and_creation(self, tmp_path):
+        from yadcc_tpu.daemon.temp_dir import (clean_stale_temp_dirs,
+                                               make_temp_dir)
+
+        (tmp_path / "ytpu_stale1").mkdir()
+        (tmp_path / "ytpu_stale2").mkdir()
+        (tmp_path / "unrelated").mkdir()
+        assert clean_stale_temp_dirs(str(tmp_path)) == 2
+        assert (tmp_path / "unrelated").exists()
+        d = make_temp_dir(str(tmp_path), "cxx_")
+        assert Path(d).is_dir() and Path(d).name.startswith("ytpu_cxx_")
+        # Nonexistent root: count 0, no raise.
+        assert clean_stale_temp_dirs(str(tmp_path / "missing")) == 0
+
+
+class TestEnvOptions:
+    def test_defaults_and_overrides(self, monkeypatch):
+        from yadcc_tpu.client import env_options as eo
+
+        for var in ("YTPU_CACHE_CONTROL", "YTPU_DAEMON_PORT",
+                    "YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD"):
+            monkeypatch.delenv(var, raising=False)
+        assert eo.cache_control() == 1
+        assert eo.daemon_port() == 8334
+        monkeypatch.setenv("YTPU_CACHE_CONTROL", "2")
+        assert eo.cache_control() == 2
+        monkeypatch.setenv("YTPU_CACHE_CONTROL", "7")   # out of range
+        assert eo.cache_control() == 1
+        monkeypatch.setenv("YTPU_DAEMON_PORT", "junk")  # unparsable
+        assert eo.daemon_port() == 8334
+
+
+class TestFsutil:
+    def test_tree_roundtrip(self, tmp_path):
+        from yadcc_tpu.common import fsutil
+
+        fsutil.mkdirs(tmp_path / "a/b")
+        fsutil.write_all(tmp_path / "a/b/file.bin", b"\x00\x01")
+        fsutil.write_all(tmp_path / "a/top.txt", b"hi")
+        tree = fsutil.read_tree(tmp_path)
+        assert tree == {"a/b/file.bin": b"\x00\x01", "a/top.txt": b"hi"}
+        mtime, size = fsutil.file_mtime_size(tmp_path / "a/top.txt")
+        assert size == 2 and mtime > 0
+        fsutil.remove_tree(tmp_path / "a")
+        assert fsutil.enumerate_files(tmp_path) == []
+
+
+class TestInspectServer:
+    def _get(self, port, path, auth=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        headers = {}
+        if auth:
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                auth.encode()).decode()
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp.status, body
+
+    def test_vars_served_and_credential_gated(self):
+        from yadcc_tpu.utils import exposed_vars
+        from yadcc_tpu.utils.inspect_server import InspectServer
+
+        exposed_vars.expose("unit/probe", lambda: {"n": 42})
+        srv = InspectServer(port=0, credential="op:secret")
+        srv.start()
+        try:
+            status, _ = self._get(srv.port, "/inspect/vars")
+            assert status == 401  # no credentials -> denied
+            status, _ = self._get(srv.port, "/inspect/vars",
+                                  auth="op:wrong")
+            assert status == 401
+            status, body = self._get(srv.port, "/inspect/vars",
+                                     auth="op:secret")
+            assert status == 200
+            assert json.loads(body)["unit"]["probe"]["n"] == 42
+        finally:
+            srv.stop()
+            exposed_vars.unexpose("unit/probe")
+
+    def test_open_when_no_credential(self):
+        from yadcc_tpu.utils.inspect_server import InspectServer
+
+        srv = InspectServer(port=0, credential="")
+        srv.start()
+        try:
+            status, _ = self._get(srv.port, "/inspect/vars")
+            assert status == 200
+        finally:
+            srv.stop()
+
+
+class TestInstaller:
+    def test_python_client_farm(self, tmp_path):
+        from yadcc_tpu.tools.install_client import install
+
+        install(str(tmp_path / "farm"), use_python_client=True)
+        gxx = tmp_path / "farm" / "g++"
+        assert gxx.exists() and os.access(gxx, os.X_OK)
+        body = gxx.read_text()
+        assert "yadcc_tpu.client.yadcc_cxx" in body
+        assert "YTPU_WRAPPER_DIR" in body  # fork-loop guard marker
+        assert (tmp_path / "farm" / "javac").exists()
+
+    def test_native_farm_builds_from_source(self, tmp_path, native_build):
+        from yadcc_tpu.tools.install_client import install
+
+        install(str(tmp_path / "farm"))
+        gxx = tmp_path / "farm" / "g++"
+        assert gxx.is_symlink()
+        assert os.path.realpath(gxx).endswith("native/ytpu-cxx")
+
+
+class TestProtoGeneration:
+    def test_regeneration_is_idempotent(self):
+        """build_protos must reproduce the checked-in gen/ exactly —
+        drift between .proto sources and generated stubs is a silent
+        wire break."""
+        before = {}
+        gen = REPO / "yadcc_tpu" / "api" / "gen"
+        for p in gen.glob("*_pb2.py"):
+            before[p.name] = p.read_bytes()
+        r = subprocess.run([sys.executable,
+                            str(REPO / "yadcc_tpu/api/build_protos.py")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        for p in gen.glob("*_pb2.py"):
+            assert before.get(p.name) == p.read_bytes(), \
+                f"{p.name} drifted from its .proto"
+
+
+class TestPrivilege:
+    @pytest.mark.skipif(os.geteuid() != 0, reason="needs root")
+    def test_drop_in_subprocess(self):
+        code = (
+            "import os\n"
+            "from yadcc_tpu.daemon.privilege import drop_privileges\n"
+            "drop_privileges()\n"
+            "print(os.geteuid())\n"
+        )
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin"})
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.strip() != "0", "still root after drop"
