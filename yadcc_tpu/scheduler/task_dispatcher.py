@@ -128,6 +128,18 @@ class TaskDispatcher:
         # ip -> slots on that machine: requestor self-avoidance lookups
         # happen per grant request and must not scan 5k locations.
         self._by_ip: Dict[str, set] = {}
+        # The struct-of-arrays pool view, maintained INCREMENTALLY at
+        # heartbeat/grant/free time — the per-cycle snapshot is a
+        # memcpy, not an O(S) Python rebuild (the host-side scan this
+        # design exists to eliminate; reference's per-request version is
+        # its documented bottleneck, task_dispatcher.h:283-288).
+        self._arr_alive = np.zeros(max_servants, bool)
+        self._arr_capacity = np.zeros(max_servants, np.int32)
+        self._arr_running = np.zeros(max_servants, np.int32)
+        self._arr_dedicated = np.zeros(max_servants, bool)
+        self._arr_version = np.zeros(max_servants, np.int32)
+        self._arr_env = np.zeros((max_servants, self._env_words),
+                                 np.uint32)
 
         self._grants: Dict[int, _Grant] = {}
         self._next_grant_id = 1
@@ -175,6 +187,7 @@ class TaskDispatcher:
             servant.expires_at = self._clock.now() + expires_in_s
             for digest in info.env_digests:
                 self._envs.intern(digest)
+            self._refresh_slot_arrays_locked(slot, envs_too=True)
             self._work.notify_all()
             return True
 
@@ -398,8 +411,13 @@ class TaskDispatcher:
                 servant = self._slots[pick] if pick < len(self._slots) else None
                 if servant is None:
                     continue  # died between snapshot and apply
-                # Re-validate capacity at apply time; the snapshot may be
-                # stale (capacity shrank, other grants applied).
+                # Re-validate at apply time; the snapshot may be stale
+                # (capacity shrank, other grants applied) and the SLOT
+                # may have been recycled to a different machine while
+                # the policy ran unlocked — a freed slot is reused by
+                # the next registration, which may serve different envs.
+                if req.env_digest not in servant.info.env_digests:
+                    continue
                 if len(servant.running_grants) >= self._effective_capacity_locked(
                     servant
                 ):
@@ -415,6 +433,7 @@ class TaskDispatcher:
                 self._next_grant_id += 1
                 self._grants[g.grant_id] = g
                 servant.running_grants.add(g.grant_id)
+                self._refresh_slot_arrays_locked(pick)
                 req.grants.append(g)
                 if is_prefetch:
                     req.prefetch_left -= 1
@@ -462,6 +481,33 @@ class TaskDispatcher:
     def _finish_satisfied_locked(self, now: float) -> None:
         self._expire_pending_locked(now)
 
+    def _refresh_slot_arrays_locked(self, slot: int,
+                                    envs_too: bool = False) -> None:
+        """Bring the pool arrays in line with slot state.  O(1) (plus
+        the env row when requested); called wherever servant info or
+        grant counts change."""
+        servant = self._slots[slot]
+        if servant is None:
+            self._arr_alive[slot] = False
+            self._arr_capacity[slot] = 0
+            self._arr_running[slot] = 0
+            self._arr_dedicated[slot] = False
+            self._arr_version[slot] = 0
+            self._arr_env[slot] = 0
+            return
+        self._arr_alive[slot] = True
+        self._arr_capacity[slot] = self._effective_capacity_locked(servant)
+        self._arr_running[slot] = len(servant.running_grants)
+        self._arr_dedicated[slot] = servant.info.dedicated
+        self._arr_version[slot] = servant.info.version
+        if envs_too:
+            self._arr_env[slot] = 0
+            for digest in servant.info.env_digests:
+                env_id = self._envs.lookup(digest)
+                if env_id is not None:
+                    self._arr_env[slot, env_id >> 5] |= np.uint32(
+                        1 << (env_id & 31))
+
     def _effective_capacity_locked(self, servant: _Servant) -> int:
         """Reference GetCapacityAvailable (task_dispatcher.cc:283-313):
         zero if not accepting or memory-starved, else reported capacity
@@ -477,29 +523,16 @@ class TaskDispatcher:
         return max(0, min(info.capacity, info.num_processors - foreign_load))
 
     def _snapshot_locked(self) -> PoolSnapshot:
-        s = self.max_servants
-        alive = np.zeros(s, bool)
-        capacity = np.zeros(s, np.int32)
-        running = np.zeros(s, np.int32)
-        dedicated = np.zeros(s, bool)
-        version = np.zeros(s, np.int32)
-        env_bitmap = np.zeros((s, self._env_words), np.uint32)
-        for slot, servant in enumerate(self._slots):
-            if servant is None:
-                continue
-            alive[slot] = True
-            capacity[slot] = self._effective_capacity_locked(servant)
-            running[slot] = len(servant.running_grants)
-            dedicated[slot] = servant.info.dedicated
-            version[slot] = servant.info.version
-            for digest in servant.info.env_digests:
-                env_id = self._envs.lookup(digest)
-                if env_id is not None:
-                    env_bitmap[slot, env_id >> 5] |= np.uint32(
-                        1 << (env_id & 31)
-                    )
-        return PoolSnapshot(alive, capacity, running, dedicated, version,
-                            env_bitmap)
+        # Copies (memcpy, not a Python loop): the policy runs outside
+        # the lock while heartbeats keep mutating the live arrays.
+        return PoolSnapshot(
+            self._arr_alive.copy(),
+            self._arr_capacity.copy(),
+            self._arr_running.copy(),
+            self._arr_dedicated.copy(),
+            self._arr_version.copy(),
+            self._arr_env.copy(),
+        )
 
     def _drop_servant_locked(self, slot: int) -> None:
         servant = self._slots[slot]
@@ -519,12 +552,14 @@ class TaskDispatcher:
                 del self._by_ip[ip]
         self._slots[slot] = None
         self._free_slots.append(slot)
+        self._refresh_slot_arrays_locked(slot)
 
     def _release_grant_locked(self, g: _Grant) -> None:
         self._grants.pop(g.grant_id, None)
         servant = self._slots[g.slot] if g.slot < len(self._slots) else None
         if servant is not None and servant.info.location == g.servant_location:
             servant.running_grants.discard(g.grant_id)
+            self._refresh_slot_arrays_locked(g.slot)
 
     # ------------------------------------------------------------------
 
