@@ -112,6 +112,12 @@ def main() -> None:
     per_sec = granted / elapsed
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))
     target = 50_000.0
+
+    # Secondary metric: grants/sec through the FULL TaskDispatcher —
+    # incremental snapshot, policy kernel, lease bookkeeping, apply
+    # phase — not just the raw kernel.  5000 live servants, 512-request
+    # backlog per cycle (BASELINE "p99 @5k workers" scenario).
+    disp_per_sec = _dispatcher_cycle_throughput()
     print(json.dumps({
         "metric": "scheduler_assignments_per_sec_5k_workers",
         "value": round(per_sec, 1),
@@ -121,10 +127,68 @@ def main() -> None:
         "batch_size": T,
         "pool_size": S,
         "kernel": "grouped",
+        "dispatcher_grants_per_sec": disp_per_sec,
         "device": str(jax.devices()[0]),
         # A CPU number must never masquerade as a TPU number.
         "cpu_fallback": bool(os.environ.get("BENCH_FORCE_CPU")),
     }))
+
+
+def _dispatcher_cycle_throughput(n_servants: int = 5000,
+                                 backlog: int = 512,
+                                 cycles: int = 30) -> float:
+    from yadcc_tpu.scheduler.policy import JaxGroupedPolicy
+    from yadcc_tpu.scheduler.task_dispatcher import (ServantInfo,
+                                                     TaskDispatcher)
+    from yadcc_tpu.utils.clock import VirtualClock
+
+    clock = VirtualClock(0)
+    d = TaskDispatcher(JaxGroupedPolicy(), max_servants=8192, max_envs=256,
+                       clock=clock, batch_window_s=0.0,
+                       start_dispatch_thread=False)
+    rng = np.random.default_rng(7)
+    for i in range(n_servants):
+        d.keep_servant_alive(ServantInfo(
+            location=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}:8335",
+            version=1, capacity=int(rng.integers(8, 64)),
+            num_processors=64, memory_available=64 << 30,
+            dedicated=bool(rng.random() < 0.3),
+            env_digests=(f"env{i % 8}",)), 3600.0)
+
+    import threading
+
+    granted = 0
+    t0 = None
+    for c in range(cycles + 1):
+        # A fresh 512-request backlog each cycle, a few envs (one build
+        # floods one env), waited on by threads like real RPC handlers.
+        threads = [
+            threading.Thread(
+                target=d.wait_for_starting_new_task,
+                args=(f"env{j % 4}",),
+                kwargs=dict(immediate=backlog // 8, timeout_s=5.0),
+                daemon=True)
+            for j in range(8)
+        ]
+        for t in threads:
+            t.start()
+        # Let the waiters park before the single explicit cycle (cheap
+        # probe — inspect() builds the full servant table).
+        deadline = time.time() + 2
+        while time.time() < deadline and len(d._pending) < 8:
+            time.sleep(0.001)
+        if c == 1:
+            t0 = time.perf_counter()
+        n = d.run_dispatch_cycle_for_testing()
+        if c >= 1:
+            granted += n
+        for t in threads:
+            t.join(timeout=5)
+        # Retire everything so the pool never saturates.
+        d.free_task([g.grant_id for g in d.get_running_tasks()])
+    elapsed = time.perf_counter() - t0
+    d.stop()
+    return round(granted / elapsed, 1)
 
 
 def _orchestrate() -> None:

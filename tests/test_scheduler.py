@@ -356,9 +356,9 @@ def test_jax_sharded_policy_matches_oracle():
     )
     reqs = [AssignRequest(int(rng.integers(0, 256)), 1, -1)
             for _ in range(40)]
-    want = GreedyCpuPolicy().assign(
-        PoolSnapshot(**{k: getattr(snap, k).copy()
-                        for k in snap.__dataclass_fields__}), reqs)
+    import copy
+
+    want = GreedyCpuPolicy().assign(copy.deepcopy(snap), reqs)
     got = JaxShardedPolicy(max_servants=s).assign(snap, reqs)
     assert got == want
 

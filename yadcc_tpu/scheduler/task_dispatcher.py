@@ -128,18 +128,33 @@ class TaskDispatcher:
         # ip -> slots on that machine: requestor self-avoidance lookups
         # happen per grant request and must not scan 5k locations.
         self._by_ip: Dict[str, set] = {}
-        # The struct-of-arrays pool view, maintained INCREMENTALLY at
-        # heartbeat/grant/free time — the per-cycle snapshot is a
-        # memcpy, not an O(S) Python rebuild (the host-side scan this
-        # design exists to eliminate; reference's per-request version is
+        # The struct-of-arrays pool view, maintained INCREMENTALLY —
+        # the per-cycle snapshot is a handful of vectorized numpy ops,
+        # not an O(S) Python rebuild (the host-side scan this design
+        # exists to eliminate; the reference's per-request version is
         # its documented bottleneck, task_dispatcher.h:283-288).
+        # Heartbeats write the REPORTED values; grants/frees touch only
+        # the running counter; effective capacity is derived vectorized
+        # at snapshot time, so the grant hot path never recomputes it
+        # per slot in Python.
         self._arr_alive = np.zeros(max_servants, bool)
-        self._arr_capacity = np.zeros(max_servants, np.int32)
+        self._arr_cap_rep = np.zeros(max_servants, np.int32)
+        self._arr_nprocs = np.zeros(max_servants, np.int32)
+        self._arr_load = np.zeros(max_servants, np.int32)
+        self._arr_mem_ok = np.zeros(max_servants, bool)
+        self._arr_accepting = np.zeros(max_servants, bool)
         self._arr_running = np.zeros(max_servants, np.int32)
         self._arr_dedicated = np.zeros(max_servants, bool)
         self._arr_version = np.zeros(max_servants, np.int32)
         self._arr_env = np.zeros((max_servants, self._env_words),
                                  np.uint32)
+        self._pool_epoch = 0
+        # Slot occupancy generation: bumped when a slot changes hands.
+        # The apply phase compares against its snapshot-time copy so a
+        # slot recycled to a DIFFERENT machine while the policy ran
+        # unlocked never receives a grant scored for the old occupant
+        # (whose envs/version/identity the decision was based on).
+        self._slot_generation = np.zeros(max_servants, np.int64)
 
         self._grants: Dict[int, _Grant] = {}
         self._next_grant_id = 1
@@ -180,6 +195,7 @@ class TaskDispatcher:
                 slot = self._free_slots.pop()
                 self._slots[slot] = _Servant(slot=slot, info=info)
                 self._by_location[info.location] = slot
+                self._slot_generation[slot] += 1
                 ip = info.location.rsplit(":", 1)[0]
                 self._by_ip.setdefault(ip, set()).add(slot)
             servant = self._slots[slot]
@@ -386,6 +402,7 @@ class TaskDispatcher:
             if not self._pending:
                 return 0
             snap = self._snapshot_locked()
+            snap_generation = self._slot_generation.copy()
             work: List[Tuple[_Pending, bool]] = []  # (request, is_prefetch)
             for req in self._pending:
                 for _ in range(req.immediate_left):
@@ -411,12 +428,14 @@ class TaskDispatcher:
                 servant = self._slots[pick] if pick < len(self._slots) else None
                 if servant is None:
                     continue  # died between snapshot and apply
-                # Re-validate at apply time; the snapshot may be stale
-                # (capacity shrank, other grants applied) and the SLOT
-                # may have been recycled to a different machine while
-                # the policy ran unlocked — a freed slot is reused by
-                # the next registration, which may serve different envs.
-                if req.env_digest not in servant.info.env_digests:
+                # Re-validate at apply time; the snapshot may be stale.
+                # A slot recycled to a different machine while the
+                # policy ran unlocked invalidates the whole scoring
+                # decision (envs, version gate, self-avoidance were all
+                # judged against the OLD occupant) — the generation
+                # check rejects it wholesale.  Capacity is re-checked
+                # because other grants may have applied meanwhile.
+                if self._slot_generation[pick] != snap_generation[pick]:
                     continue
                 if len(servant.running_grants) >= self._effective_capacity_locked(
                     servant
@@ -433,7 +452,7 @@ class TaskDispatcher:
                 self._next_grant_id += 1
                 self._grants[g.grant_id] = g
                 servant.running_grants.add(g.grant_id)
-                self._refresh_slot_arrays_locked(pick)
+                self._arr_running[pick] += 1
                 req.grants.append(g)
                 if is_prefetch:
                     req.prefetch_left -= 1
@@ -484,29 +503,56 @@ class TaskDispatcher:
     def _refresh_slot_arrays_locked(self, slot: int,
                                     envs_too: bool = False) -> None:
         """Bring the pool arrays in line with slot state.  O(1) (plus
-        the env row when requested); called wherever servant info or
-        grant counts change."""
+        the env row when requested); called on heartbeat upserts and
+        slot drops — NOT on grants/frees, which only adjust
+        _arr_running.  The pool epoch (the device policies' cache key
+        for their resident static arrays) advances ONLY when a
+        device-cached field actually changes: at a 1s heartbeat cadence
+        with thousands of servants, load/memory/capacity churn every
+        beat but alive/dedicated/version/envs almost never do — an
+        unconditional bump would defeat the cache in exactly the
+        production scenario it exists for."""
         servant = self._slots[slot]
         if servant is None:
+            if self._arr_alive[slot]:
+                self._pool_epoch += 1
             self._arr_alive[slot] = False
-            self._arr_capacity[slot] = 0
+            self._arr_cap_rep[slot] = 0
+            self._arr_nprocs[slot] = 0
+            self._arr_load[slot] = 0
+            self._arr_mem_ok[slot] = False
+            self._arr_accepting[slot] = False
             self._arr_running[slot] = 0
             self._arr_dedicated[slot] = False
             self._arr_version[slot] = 0
             self._arr_env[slot] = 0
             return
-        self._arr_alive[slot] = True
-        self._arr_capacity[slot] = self._effective_capacity_locked(servant)
+        info = servant.info
+        # Re-uploaded every cycle (capacity/running vectors): no epoch.
+        self._arr_cap_rep[slot] = info.capacity
+        self._arr_nprocs[slot] = info.num_processors
+        self._arr_load[slot] = info.current_load
+        self._arr_mem_ok[slot] = info.memory_available >= self._min_memory
+        self._arr_accepting[slot] = info.not_accepting_reason == 0
         self._arr_running[slot] = len(servant.running_grants)
-        self._arr_dedicated[slot] = servant.info.dedicated
-        self._arr_version[slot] = servant.info.version
+        # Device-cached statics: epoch bump only on change.
+        changed = (not self._arr_alive[slot]
+                   or bool(self._arr_dedicated[slot]) != info.dedicated
+                   or int(self._arr_version[slot]) != info.version)
+        self._arr_alive[slot] = True
+        self._arr_dedicated[slot] = info.dedicated
+        self._arr_version[slot] = info.version
         if envs_too:
-            self._arr_env[slot] = 0
-            for digest in servant.info.env_digests:
+            row = np.zeros(self._env_words, np.uint32)
+            for digest in info.env_digests:
                 env_id = self._envs.lookup(digest)
                 if env_id is not None:
-                    self._arr_env[slot, env_id >> 5] |= np.uint32(
-                        1 << (env_id & 31))
+                    row[env_id >> 5] |= np.uint32(1 << (env_id & 31))
+            if not np.array_equal(row, self._arr_env[slot]):
+                changed = True
+                self._arr_env[slot] = row
+        if changed:
+            self._pool_epoch += 1
 
     def _effective_capacity_locked(self, servant: _Servant) -> int:
         """Reference GetCapacityAvailable (task_dispatcher.cc:283-313):
@@ -523,15 +569,24 @@ class TaskDispatcher:
         return max(0, min(info.capacity, info.num_processors - foreign_load))
 
     def _snapshot_locked(self) -> PoolSnapshot:
-        # Copies (memcpy, not a Python loop): the policy runs outside
-        # the lock while heartbeats keep mutating the live arrays.
+        # Effective capacity, vectorized (the per-servant semantics of
+        # _effective_capacity_locked): zero unless accepting with
+        # enough memory, else min(reported, nprocs - foreign load).
+        foreign = np.maximum(self._arr_load - self._arr_running, 0)
+        effective = np.minimum(self._arr_cap_rep,
+                               self._arr_nprocs - foreign)
+        effective = np.where(self._arr_accepting & self._arr_mem_ok,
+                             np.maximum(effective, 0), 0).astype(np.int32)
+        # Copies: the policy runs outside the lock while heartbeats
+        # keep mutating the live arrays.
         return PoolSnapshot(
             self._arr_alive.copy(),
-            self._arr_capacity.copy(),
+            effective,
             self._arr_running.copy(),
             self._arr_dedicated.copy(),
             self._arr_version.copy(),
             self._arr_env.copy(),
+            epoch=self._pool_epoch,
         )
 
     def _drop_servant_locked(self, slot: int) -> None:
@@ -558,8 +613,9 @@ class TaskDispatcher:
         self._grants.pop(g.grant_id, None)
         servant = self._slots[g.slot] if g.slot < len(self._slots) else None
         if servant is not None and servant.info.location == g.servant_location:
-            servant.running_grants.discard(g.grant_id)
-            self._refresh_slot_arrays_locked(g.slot)
+            if g.grant_id in servant.running_grants:
+                servant.running_grants.discard(g.grant_id)
+                self._arr_running[g.slot] -= 1
 
     # ------------------------------------------------------------------
 

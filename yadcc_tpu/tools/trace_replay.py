@@ -139,9 +139,13 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
         snap = _snapshot_from_pool(events[0])
         # Untimed warmup: the jit policies pay one-time compilation on
         # their first call, which must not skew the A/B throughput.
-        # Policies only mutate their own running copy, so a fresh
-        # snapshot for the real run is all the isolation needed.
-        policy.assign(snap, [AssignRequest(0, 1, -1)])
+        # Distinct-descriptor counts cover every padded group shape the
+        # grouped policy may compile (8/16/32/64).  Policies only
+        # mutate their own running copy, so a fresh snapshot for the
+        # real run is all the isolation needed.
+        for n in (1, 12, 24, 48):
+            policy.assign(snap, [AssignRequest(e, 1, -1)
+                                 for e in range(n)])
         snap = _snapshot_from_pool(events[0])
         outcomes = []
         granted = 0
