@@ -249,3 +249,25 @@ class TestDependencyFiles:
         assert (workdir / "depn.o").exists()
         dep = (workdir / "depn.d").read_text()
         assert "depn.cc" in dep and "iostream" in dep
+
+
+def test_native_client_falls_back_when_daemon_unreachable(native_client,
+                                                          tmp_path,
+                                                          monkeypatch):
+    """No daemon at all: the client must still produce the object by
+    compiling locally (a broken cluster slows builds, never fails
+    them)."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "solo.cc").write_text(SOURCE)
+    env = dict(os.environ, YTPU_DAEMON_PORT="1",  # nothing listens there
+               YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD="1")
+    r = subprocess.run([str(native_client), "g++", "-O2", "-c", "solo.cc",
+                        "-o", "solo.o"], cwd=tmp_path, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "solo.o").exists()
+    subprocess.run([GXX, "solo.o", "-o", "solobin"], cwd=tmp_path,
+                   check=True)
+    out = subprocess.run(["./solobin"], cwd=tmp_path, capture_output=True,
+                         text=True)
+    assert out.stdout.strip() == "hello from ytpu e2e"
