@@ -246,3 +246,42 @@ def test_universal_wrapper_governs_quota(tmp_path):
         assert cluster.http.monitor.inspect()["holders"] == 0
     finally:
         cluster.stop()
+
+
+def test_cross_delegate_dedup(tmp_path):
+    """TWO delegates (two build machines) submit the same TU while it
+    compiles: delegate B must join delegate A's in-flight servant
+    execution via the scheduler's running-task bookkeeping — the
+    cluster-wide dedup the reference builds RunningTaskKeeper +
+    ReferenceTask for."""
+    compiler = make_fake_compiler(str(tmp_path / "bin"), compile_s=5.0)
+    cd = digest_file(compiler)
+    cluster = LocalCluster(tmp_path, n_servants=2, servant_concurrency=2,
+                           compiler_dirs=[str(tmp_path / "bin")])
+    delegate_b = cluster.make_extra_delegate()
+    try:
+        src = b"int cross_machine();"
+        results = {}
+
+        def submit(name, delegate, delay):
+            time.sleep(delay)
+            tid = delegate.queue_task(make_task(cd, src, 0))
+            r = delegate.wait_for_task(tid, 60)
+            results[name] = None if r is None else r.exit_code
+
+        threads = [
+            threading.Thread(target=submit,
+                             args=("a", cluster.delegate, 0.0)),
+            threading.Thread(target=submit, args=("b", delegate_b, 2.5)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results == {"a": 0, "b": 0}
+        total_runs = sum(s.engine.tasks_run_ever for s in cluster.servants)
+        assert total_runs == 1, "duplicate was compiled twice"
+        assert delegate_b.inspect()["stats"]["reused"] == 1
+        assert cluster.delegate.inspect()["stats"]["actually_run"] == 1
+    finally:
+        cluster.stop()

@@ -174,6 +174,7 @@ class LocalCluster:
             dispatcher=self.delegate,
             port=http_port,
         )
+        self._extra_keepers: List[RunningTaskKeeper] = []
         self.cache_reader.start()
         self.running_keeper.start()
         for servant in self.servants:
@@ -187,8 +188,24 @@ class LocalCluster:
         assert len(self.sched_dispatcher.inspect()["servants"]) \
             == n_servants, "servants failed to register"
 
+    def make_extra_delegate(self) -> DistributedTaskDispatcher:
+        """A second delegate, as another build machine would run: own
+        grant keeper, own running-task snapshot, sharing only the
+        cluster services.  Caller-owned (not stopped by stop())."""
+        keeper = RunningTaskKeeper(self.sched_uri, refresh_interval_s=0.5)
+        keeper.start()
+        self._extra_keepers.append(keeper)
+        return DistributedTaskDispatcher(
+            grant_keeper=TaskGrantKeeper(self.sched_uri, ""),
+            config_keeper=self.config_keeper,
+            cache_reader=self.cache_reader,
+            running_task_keeper=keeper,
+        )
+
     def stop(self):
         self.http.stop()
+        for k in self._extra_keepers:
+            k.stop()
         self.running_keeper.stop()
         self.cache_reader.stop()
         for servant in self.servants:
