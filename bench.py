@@ -118,6 +118,17 @@ def main() -> None:
     # phase — not just the raw kernel.  5000 live servants, 512-request
     # backlog per cycle (BASELINE "p99 @5k workers" scenario).
     disp_per_sec = _dispatcher_cycle_throughput()
+
+    # On real TPU hardware, also record the Pallas-vs-grouped A/B (the
+    # native-compile validation a CPU run can't provide): same pool,
+    # same workload, parity-checked, then timed.
+    pallas = None
+    if jax.devices()[0].platform == "tpu" \
+            and not os.environ.get("BENCH_SKIP_PALLAS"):
+        try:
+            pallas = _pallas_ab(static, S, T, E_WORDS, rng)
+        except Exception as e:  # Mosaic lowering is unproven on HW
+            pallas = {"error": f"{type(e).__name__}: {e}"[:300]}
     print(json.dumps({
         "metric": "scheduler_assignments_per_sec_5k_workers",
         "value": round(per_sec, 1),
@@ -128,10 +139,45 @@ def main() -> None:
         "pool_size": S,
         "kernel": "grouped",
         "dispatcher_grants_per_sec": disp_per_sec,
+        "pallas_ab": pallas,
         "device": str(jax.devices()[0]),
         # A CPU number must never masquerade as a TPU number.
         "cpu_fallback": bool(os.environ.get("BENCH_FORCE_CPU")),
     }))
+
+
+def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
+    """Native-compile the Pallas kernel at the production shape, check
+    parity against the exact scan kernel, and time it.  TPU only (the
+    interpreter path is parity-tested in CI instead)."""
+    import jax
+    import jax.numpy as jnp
+
+    from yadcc_tpu.ops import assignment as asn
+    from yadcc_tpu.ops.pallas_assign import pallas_assign_batch
+
+    running = jnp.zeros(S, jnp.int32)
+    pool = asn.PoolArrays(running=running, **static)
+    envs = list(rng.integers(0, E_WORDS * 32, T))
+    batch = asn.make_batch(envs, [1] * T, [-1] * T, pad_to=T)
+
+    p_picks, p_running = pallas_assign_batch(pool, batch)   # compiles
+    s_picks, s_running = asn.assign_batch(pool, batch)
+    parity = bool(
+        np.array_equal(np.asarray(p_picks), np.asarray(s_picks))
+        and np.array_equal(np.asarray(p_running), np.asarray(s_running)))
+
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        p_picks, _ = pallas_assign_batch(pool, batch)
+    p_picks.block_until_ready()
+    dt = time.perf_counter() - t0
+    granted = int((np.asarray(p_picks) >= 0).sum())
+    return {
+        "native_compile_ok": True,
+        "parity_with_scan_kernel": parity,
+        "assignments_per_sec": round(batches * granted / dt, 1),
+    }
 
 
 def _dispatcher_cycle_throughput(n_servants: int = 5000,
