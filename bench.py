@@ -167,16 +167,35 @@ def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
         np.array_equal(np.asarray(p_picks), np.asarray(s_picks))
         and np.array_equal(np.asarray(p_running), np.asarray(s_running)))
 
+    # Same steady-state shape as the headline loop: thread `running`
+    # through and retire a fraction off the timed path, so the two
+    # numbers are comparable at the same ~55% occupancy.
+    @jax.jit
+    def free_fraction(r, frac):
+        return jnp.maximum(
+            r - (r.astype(jnp.float32) * frac).astype(jnp.int32), 0)
+
+    total_capacity = int(np.asarray(static["capacity"])[
+        np.asarray(static["alive"])].sum())
+    granted = 0
     t0 = time.perf_counter()
+    elapsed = 0.0
     for _ in range(batches):
-        p_picks, _ = pallas_assign_batch(pool, batch)
-    p_picks.block_until_ready()
-    dt = time.perf_counter() - t0
-    granted = int((np.asarray(p_picks) >= 0).sum())
+        p_picks, running = pallas_assign_batch(
+            asn.PoolArrays(running=running, **static), batch)
+        p_picks.block_until_ready()
+        elapsed += time.perf_counter() - t0
+        granted += int((np.asarray(p_picks) >= 0).sum())
+        occ = int(np.asarray(running).sum())
+        extra = occ - 0.55 * total_capacity
+        if extra > 0:
+            running = free_fraction(running,
+                                    jnp.float32(extra / max(occ, 1)))
+        t0 = time.perf_counter()
     return {
         "native_compile_ok": True,
         "parity_with_scan_kernel": parity,
-        "assignments_per_sec": round(batches * granted / dt, 1),
+        "assignments_per_sec": round(granted / elapsed, 1),
     }
 
 

@@ -13,6 +13,7 @@ at a slot that was dead when picked.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -39,17 +40,18 @@ def servant_info(i: int) -> ServantInfo:
     )
 
 
-@pytest.mark.parametrize("policy_name", ["greedy_cpu", "jax_grouped"])
-def test_dispatcher_survives_churn_storm(policy_name):
+def _run_churn_storm(policy_name: str, *, n_servants: int = 60,
+                     ticks: int = 40, max_servants: int = 128) -> dict:
+    """Shared storm body; returns the final inspect() dict."""
     policy = {
         "greedy_cpu": lambda: GreedyCpuPolicy(DispatchCostModel()),
         "jax_grouped": lambda: JaxGroupedPolicy(max_groups=8),
     }[policy_name]()
     clock = VirtualClock(1000.0)
-    d = TaskDispatcher(policy, max_servants=128, max_envs=64, clock=clock,
-                       batch_window_s=0.0, start_dispatch_thread=True)
+    d = TaskDispatcher(policy, max_servants=max_servants, max_envs=64,
+                       clock=clock, batch_window_s=0.0,
+                       start_dispatch_thread=True)
 
-    n_servants = 60
     stop = threading.Event()
     state_lock = threading.Lock()
     alive: dict[int, float] = {i: clock.now() for i in range(n_servants)}
@@ -105,7 +107,9 @@ def test_dispatcher_survives_churn_storm(policy_name):
         """One virtual second: heartbeats, deaths, joins, leaves."""
         now = clock.now()
         with state_lock:
-            dead_roll = rng.sample(sorted(alive), k=min(4, len(alive)))
+            dead_roll = rng.sample(sorted(alive),
+                                   k=min(max(4, n_servants // 15),
+                                         len(alive)))
         for i in dead_roll:
             r = rng.random()
             if r < 0.3:
@@ -128,7 +132,7 @@ def test_dispatcher_survives_churn_storm(policy_name):
             if d.keep_servant_alive(info, 10.0):
                 with state_lock:
                     last_alive[info.location] = now
-                reported = sorted(servant_running[info.location])
+                    reported = sorted(servant_running[info.location])
                 to_kill = d.notify_servant_running_tasks(
                     info.location, reported)
                 with state_lock:
@@ -146,7 +150,7 @@ def test_dispatcher_survives_churn_storm(policy_name):
 
     rng = random.Random(7)
     try:
-        for tick in range(40):
+        for tick in range(ticks):
             churn_tick(rng)
             clock.advance(1.0)
             d.on_expiration_timer()
@@ -187,6 +191,12 @@ def test_dispatcher_survives_churn_storm(policy_name):
     got = d.wait_for_starting_new_task(ENVS[0], immediate=1, timeout_s=5.0)
     assert len(got) == 1
     d.stop()
+    return snap
+
+
+@pytest.mark.parametrize("policy_name", ["greedy_cpu", "jax_grouped"])
+def test_dispatcher_survives_churn_storm(policy_name):
+    _run_churn_storm(policy_name)
 
 
 def test_execution_engine_stability_stress(tmp_path):
@@ -276,3 +286,23 @@ def test_execution_engine_stability_stress(tmp_path):
     out = subprocess.run(["pgrep", "-f", "sleep 30"], capture_output=True,
                          text=True).stdout.split()
     assert not out, f"leaked subprocesses: {out}"
+
+
+@pytest.mark.skipif(not os.environ.get("YTPU_BIG_STORM"),
+                    reason="opt-in: YTPU_BIG_STORM=1 (several minutes)")
+def test_dispatcher_churn_storm_at_scale():
+    """The 5k-class churn scenario (opt-in): 1024 servants with the
+    device policy, same invariants as the small storm.  Run via
+    YTPU_BIG_STORM=1; artifacts/churn_storm.json records a result."""
+    import json
+
+    snap = _run_churn_storm("jax_grouped", n_servants=1024, ticks=30,
+                            max_servants=2048)
+    import pathlib
+
+    out = {"n_servants": 1024, "ticks": 30, "policy": "jax_grouped",
+           "stats": snap["stats"]}
+    artifacts = pathlib.Path(__file__).resolve().parent.parent / "artifacts"
+    artifacts.mkdir(exist_ok=True)
+    with open(artifacts / "churn_storm.json", "w") as fp:
+        json.dump(out, fp, indent=2)
