@@ -730,6 +730,13 @@ int main(int argc, char **argv) {
     return 127;
   }
 
+  if (env_int("YTPU_DEBUGGING_COMPILE_LOCALLY", 0)) {
+    // Same knob as the Python client: isolate whether a bad object
+    // came from distribution or from the compiler itself.
+    logf(30, "YTPU_DEBUGGING_COMPILE_LOCALLY=1: compiling locally");
+    return compile_locally(compiler, argv);
+  }
+
   const char *why = "";
   if (!is_distributable(args, &why)) {
     logf(10, "local (%s)", why);
@@ -774,6 +781,8 @@ int main(int argc, char **argv) {
         ", \"source_digest\": " + json_str(pre.digest) +
         ", \"compiler_invocation_arguments\": " + json_str(inv) +
         ", \"cache_control\": " + std::to_string(cache_control) +
+        ", \"ignore_timestamp_macros\": " +
+        (env_int("YTPU_IGNORE_TIMESTAMP_MACROS", 0) ? "true" : "false") +
         ", \"compiler\": " + file_desc(compiler).json + "}";
     std::string body = make_multi_chunk({submit_json, pre.compressed});
     HttpResponse r = call_daemon("POST", "/local/submit_cxx_task", body);

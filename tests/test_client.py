@@ -165,3 +165,36 @@ class TestWriteResults:
             {".o": b"OBJ", ".gcno": b"NOTES"}, args)
         assert (tmp_path / "out/foo.o").read_bytes() == b"OBJ"
         assert (tmp_path / "out/foo.gcno").read_bytes() == b"NOTES"
+
+
+class TestNewEnvKnobs:
+    def test_timestamp_macro_scan_across_chunks(self):
+        from yadcc_tpu.client.rewrite_file import _TimestampScanWriter
+
+        w = _TimestampScanWriter()
+        w.write(b"int x; // __TI")
+        w.write(b"ME__ straddles the chunk boundary")
+        assert w.found
+        w2 = _TimestampScanWriter()
+        w2.write(b"clean " * 1000)
+        assert not w2.found
+
+    def test_debugging_compile_locally_short_circuits(self, monkeypatch):
+        from yadcc_tpu.client import yadcc_cxx
+
+        monkeypatch.setenv("YTPU_DEBUGGING_COMPILE_LOCALLY", "1")
+        called = {}
+        monkeypatch.setattr(yadcc_cxx, "_compile_locally",
+                            lambda c, a: called.setdefault("local", 0) or 0)
+        monkeypatch.setattr(yadcc_cxx, "find_real_compiler",
+                            lambda n: "/usr/bin/g++")
+        rc = yadcc_cxx.entry(["g++", "-O2", "-c", "x.cc", "-o", "x.o"])
+        assert rc == 0 and "local" in called
+
+    def test_warn_on_wait_threshold_parse(self, monkeypatch):
+        from yadcc_tpu.client.env_options import warn_on_wait_longer_than_s
+
+        monkeypatch.setenv("YTPU_WARN_ON_WAIT_LONGER_THAN", "2.5")
+        assert warn_on_wait_longer_than_s() == 2.5
+        monkeypatch.setenv("YTPU_WARN_ON_WAIT_LONGER_THAN", "junk")
+        assert warn_on_wait_longer_than_s() == 10.0

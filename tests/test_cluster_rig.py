@@ -285,3 +285,39 @@ def test_cross_delegate_dedup(tmp_path):
         assert cluster.delegate.inspect()["stats"]["actually_run"] == 1
     finally:
         cluster.stop()
+
+
+def test_ignore_timestamp_macros_wired_end_to_end(tmp_path):
+    """A __TIME__-using TU is not cached by default, but the client's
+    YTPU_IGNORE_TIMESTAMP_MACROS opt-in travels the whole protocol
+    (submit JSON -> delegate -> servant RPC) and makes the servant
+    fill the cache anyway."""
+    compiler = make_fake_compiler(str(tmp_path / "bin"))
+    cd = digest_file(compiler)
+    cluster = LocalCluster(tmp_path, n_servants=1, servant_concurrency=2,
+                           compiler_dirs=[str(tmp_path / "bin")])
+    try:
+        src = b'char now[] = __TIME__;'
+
+        def compile_once(ignore):
+            task = make_task(cd, src, 1)
+            task.ignore_timestamp_macros = ignore
+            tid = cluster.delegate.queue_task(task)
+            r = cluster.delegate.wait_for_task(tid, 60)
+            assert r is not None and r.exit_code == 0
+            cluster.delegate.free_task(tid)
+
+        compile_once(ignore=False)
+        time.sleep(1.0)  # async fill window
+        assert cluster.cache_service.inspect()["fills"] == 0, \
+            "__TIME__ TU must not be cached by default"
+
+        compile_once(ignore=True)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                cluster.cache_service.inspect()["fills"] == 0:
+            time.sleep(0.1)
+        assert cluster.cache_service.inspect()["fills"] == 1, \
+            "opt-in did not reach the servant"
+    finally:
+        cluster.stop()

@@ -271,3 +271,21 @@ def test_native_client_falls_back_when_daemon_unreachable(native_client,
     out = subprocess.run(["./solobin"], cwd=tmp_path, capture_output=True,
                          text=True)
     assert out.stdout.strip() == "hello from ytpu e2e"
+
+
+def test_ignore_timestamp_macros_full_wire(cluster, workdir, monkeypatch):
+    """__TIME__ TU with YTPU_IGNORE_TIMESTAMP_MACROS=1 through the REAL
+    client + HTTP protocol: the servant caches it and a rebuild hits."""
+    monkeypatch.setenv("YTPU_IGNORE_TIMESTAMP_MACROS", "1")
+    (workdir / "ts.cc").write_text(
+        "#include <iostream>\n"
+        "int main() { std::cout << __TIME__; }\n")
+    fills_before = cluster.cache_service.inspect()["fills"]
+    rc = client_entry(["g++", "-O2", "-c", "ts.cc", "-o", "ts.o"])
+    assert rc == 0
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            cluster.cache_service.inspect()["fills"] == fills_before:
+        time.sleep(0.1)
+    assert cluster.cache_service.inspect()["fills"] == fills_before + 1, \
+        "opt-in did not survive the client HTTP protocol"

@@ -302,7 +302,12 @@ def test_dispatcher_churn_storm_at_scale():
 
     out = {"n_servants": 1024, "ticks": 30, "policy": "jax_grouped",
            "stats": snap["stats"]}
-    artifacts = pathlib.Path(__file__).resolve().parent.parent / "artifacts"
-    artifacts.mkdir(exist_ok=True)
-    with open(artifacts / "churn_storm.json", "w") as fp:
-        json.dump(out, fp, indent=2)
+    # Write into the tree only when explicitly asked (refreshing the
+    # committed artifact); a test run must not dirty the checkout.
+    out_dir = os.environ.get("YTPU_STORM_ARTIFACT_DIR")
+    if out_dir:
+        path = pathlib.Path(out_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        with open(path / "churn_storm.json", "w") as fp:
+            json.dump(out, fp, indent=2)
+            fp.write("\n")

@@ -52,6 +52,7 @@ def submit_compilation_task(
     compressed_source: bytes,
     invocation_arguments: str,
     cache_control: int,
+    ignore_timestamp_macros: bool = False,
 ) -> int:
     """Returns the daemon task id; raises CloudError on failure."""
     msg = {
@@ -60,6 +61,7 @@ def submit_compilation_task(
         "source_digest": source_digest,
         "compiler_invocation_arguments": invocation_arguments,
         "cache_control": cache_control,
+        "ignore_timestamp_macros": ignore_timestamp_macros,
         "compiler": _file_desc(compiler_path),
     }
     body = make_multi_chunk([json.dumps(msg).encode(), compressed_source])

@@ -14,7 +14,20 @@ configuration is environment variables:
                            preprocessed sizes below this compile locally
     YTPU_IGNORE_TIMESTAMP_MACROS
                            1 = cache even with __TIME__ et al
-    YTPU_WARN_ON_WAIT      1 = warn when quota waits are slow
+                           (transmitted to the servant, which skips its
+                           cacheability scan)
+    YTPU_WARN_ON_WAIT      1 = warn when quota waits are slow (default)
+    YTPU_WARN_ON_WAIT_LONGER_THAN
+                           seconds before the wait warning (default 10)
+    YTPU_WARN_ON_NONCACHEABLE
+                           1 = warn when a TU's __TIME__-class macros
+                           block caching (override-aware)
+    YTPU_WARN_ON_NON_DISTRIBUTABLE
+                           1 = warn (not just debug-log) when an
+                           invocation can't distribute
+    YTPU_DEBUGGING_COMPILE_LOCALLY
+                           1 = force every compile local (isolate
+                           distribution from compiler bugs)
 """
 
 from __future__ import annotations
@@ -53,3 +66,33 @@ def ignore_timestamp_macros() -> bool:
 
 def warn_on_wait() -> bool:
     return _int_env("YTPU_WARN_ON_WAIT", 1) == 1
+
+
+def warn_on_wait_longer_than_s() -> float:
+    """Seconds of quota wait before warning (reference
+    YADCC_WARN_ON_WAIT_LONGER_THAN).  Default 10s: quota waits of a few
+    seconds are routine backpressure on a busy machine."""
+    try:
+        return float(os.environ.get("YTPU_WARN_ON_WAIT_LONGER_THAN", "10"))
+    except ValueError:
+        return 10.0
+
+
+def warn_on_noncacheable() -> bool:
+    """Warn when a TU uses __TIME__-class macros and thus skips the
+    cache (reference YADCC_WARN_ON_NONCACHEABLE)."""
+    return _int_env("YTPU_WARN_ON_NONCACHEABLE", 0) == 1
+
+
+def warn_on_non_distributable() -> bool:
+    """Warn when an invocation can't distribute (reference
+    YADCC_WARN_ON_NON_DISTRIBUTABLE) — spotting builds that silently
+    run everything locally."""
+    return _int_env("YTPU_WARN_ON_NON_DISTRIBUTABLE", 0) == 1
+
+
+def debugging_compile_locally() -> bool:
+    """Force every compile local, keeping the full argument pipeline
+    (reference YADCC_DEBUGGING_COMPILE_LOCALLY) — isolates whether a
+    bad object came from distribution or from the compiler itself."""
+    return _int_env("YTPU_DEBUGGING_COMPILE_LOCALLY", 0) == 1

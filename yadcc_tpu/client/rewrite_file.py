@@ -16,6 +16,7 @@ import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from ..common import cacheability
 from ..common.compress import CompressingWriter, TeeWriter
 from ..common.hashing import DigestingWriter
 from . import logging as log
@@ -29,6 +30,10 @@ class RewriteResult:
     source_digest: str
     uncompressed_size: int
     directives_only: bool  # servant must compile with matching flags
+    # Macros (bytes) found in the preprocessed output; whether they
+    # actually block caching also depends on -D overrides
+    # (common/cacheability.blocking_macros).
+    timestamp_macros_found: frozenset = frozenset()
 
 
 class _Collector:
@@ -37,6 +42,27 @@ class _Collector:
 
     def write(self, data: bytes) -> int:
         self.chunks.append(data)
+        return len(data)
+
+
+class _TimestampScanWriter:
+    """Streaming scan for the cache-poisoning macros, keeping a small
+    tail so a token straddling two chunks is still found (feeds the
+    YTPU_WARN_ON_NONCACHEABLE diagnostic; the servant independently
+    applies the same shared rule — common/cacheability.py — before
+    filling the cache)."""
+
+    def __init__(self):
+        self.found: set = set()
+        self._tail = b""
+
+    def write(self, data: bytes) -> int:
+        if len(self.found) < len(cacheability.TIMESTAMP_MACROS):
+            window = self._tail + data
+            for m in cacheability.TIMESTAMP_MACROS:
+                if m in window:
+                    self.found.add(m)
+            self._tail = window[-15:]  # longest token minus one
         return len(data)
 
 
@@ -52,7 +78,8 @@ def _run_preprocess(compiler: str, tail: List[str]) -> Optional[RewriteResult]:
     collector = _Collector()
     digester = DigestingWriter()
     zw = CompressingWriter(collector)
-    sink = TeeWriter(digester, zw)
+    ts_scan = _TimestampScanWriter()
+    sink = TeeWriter(digester, zw, ts_scan)
     env = {}
     preload = _fakeroot_path()
     if preload:
@@ -68,6 +95,7 @@ def _run_preprocess(compiler: str, tail: List[str]) -> Optional[RewriteResult]:
         source_digest=digester.hexdigest(),
         uncompressed_size=digester.bytes_written,
         directives_only=False,  # caller fills in
+        timestamp_macros_found=frozenset(ts_scan.found),
     )
 
 

@@ -14,7 +14,7 @@ import time
 
 from . import logging as log
 from .daemon_call import call_daemon
-from .env_options import warn_on_wait
+from .env_options import warn_on_wait, warn_on_wait_longer_than_s
 
 
 def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0) -> bool:
@@ -34,7 +34,7 @@ def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0) -> bool:
         if time.monotonic() - start > timeout_s:
             return False
         if warn_on_wait() and not warned and \
-                time.monotonic() - start > 10.0:
+                time.monotonic() - start > warn_on_wait_longer_than_s():
             log.warning("waiting for local task quota "
                         "(machine busy; this is backpressure, not a hang)")
             warned = True

@@ -20,6 +20,7 @@ import sys
 from typing import List, Optional
 
 from . import logging as log
+from ..common import cacheability
 from .command import pass_through_to_program
 from .compilation_saas import (
     CloudError,
@@ -29,7 +30,10 @@ from .compilation_saas import (
     write_compilation_results,
 )
 from .compiler_args import CompilerArgs, is_distributable
-from .env_options import cache_control, compile_on_cloud_size_threshold
+from .env_options import (cache_control, compile_on_cloud_size_threshold,
+                          debugging_compile_locally,
+                          ignore_timestamp_macros, warn_on_noncacheable,
+                          warn_on_non_distributable)
 from .rewrite_file import rewrite_file
 from .task_quota import task_quota
 
@@ -104,9 +108,18 @@ def entry(argv: List[str]) -> int:
         log.error(f"cannot find real compiler for {args.compiler!r}")
         return 127
 
+    if debugging_compile_locally():
+        # Keeps the full pipeline out of the picture: a bad object
+        # produced THIS way exonerates distribution entirely.
+        log.warning("YTPU_DEBUGGING_COMPILE_LOCALLY=1: compiling locally")
+        return _compile_locally(compiler, args)
+
     ok, why = is_distributable(args)
     if not ok:
-        log.debug(f"not distributable ({why}); running locally")
+        if warn_on_non_distributable():
+            log.warning(f"not distributable ({why}); running locally")
+        else:
+            log.debug(f"not distributable ({why}); running locally")
         return _compile_locally(compiler, args)
 
     # Preprocess under lightweight quota (reference rewrite_file.cc:122).
@@ -126,6 +139,19 @@ def entry(argv: List[str]) -> int:
 
     invocation = remote_invocation(args, rewritten.directives_only)
 
+    if (warn_on_noncacheable() and cache_control() != 0
+            and not ignore_timestamp_macros()):
+        # Same rule the servant applies (common/cacheability.py): only
+        # macros NOT neutralized by a -D override block caching.
+        blocking = cacheability.blocking_macros(
+            rewritten.timestamp_macros_found, invocation)
+        if blocking:
+            names = ", ".join(sorted(m.decode() for m in blocking))
+            log.warning(
+                f"{args.sources[0]}: uses {names} — compiled remotely "
+                "but NOT cached (set YTPU_IGNORE_TIMESTAMP_MACROS=1 to "
+                "cache anyway, or -D-override the macro)")
+
     source = args.sources[0]
     for attempt in range(_CLOUD_RETRIES):
         try:
@@ -136,6 +162,7 @@ def entry(argv: List[str]) -> int:
                 compressed_source=rewritten.compressed_source,
                 invocation_arguments=invocation,
                 cache_control=cache_control(),
+                ignore_timestamp_macros=ignore_timestamp_macros(),
             )
             result, patches = wait_for_compilation_task(task_id)
         except CloudError as e:

@@ -39,20 +39,9 @@ from .temporary import TemporaryDir
 # realistic client paths).
 _PADDED_WORKSPACE_LEN = 224
 
-_TIMESTAMP_MACROS = (b"__TIME__", b"__DATE__", b"__TIMESTAMP__")
-
-
-def scan_source_cacheability(source: bytes, invocation_arguments: str) -> bool:
-    """False if the preprocessed source expands timestamp macros the
-    command line doesn't override (-D__TIME__=... etc.)."""
-    overridden = set()
-    for arg in shlex.split(invocation_arguments):
-        if arg.startswith("-D"):
-            name = arg[2:].split("=", 1)[0]
-            overridden.add(name.encode())
-    return not any(
-        m in source and m not in overridden for m in _TIMESTAMP_MACROS
-    )
+# Shared with the client's YTPU_WARN_ON_NONCACHEABLE diagnostic, so the
+# warning can never disagree with the authoritative decision made here.
+from ...common.cacheability import scan_source_cacheability  # noqa: E402,F401
 
 
 def find_patch_locations(
@@ -89,6 +78,7 @@ class CloudCxxCompilationTask:
     source_path: str          # client-side path, for diagnostics
     temp_root: str
     disallow_cache_fill: bool = False
+    ignore_timestamp_macros: bool = False
 
     source: bytes = b""
     source_digest: str = ""
@@ -105,8 +95,9 @@ class CloudCxxCompilationTask:
             raise ValueError("source attachment is not valid zstd")
         self.source = src
         self.source_digest = digest_bytes(src)
-        self.cacheable = (not self.disallow_cache_fill) and \
-            scan_source_cacheability(src, self.invocation_arguments)
+        self.cacheable = (not self.disallow_cache_fill) and (
+            self.ignore_timestamp_macros
+            or scan_source_cacheability(src, self.invocation_arguments))
 
         self.workspace = TemporaryDir(self.temp_root, "cxx_")
         # Pad the workspace path by extending the directory name.

@@ -132,6 +132,7 @@ class DaemonService:
             source_path=req.source_path,
             temp_root=self.config.temporary_dir,
             disallow_cache_fill=req.disallow_cache_fill,
+            ignore_timestamp_macros=req.ignore_timestamp_macros,
         )
         try:
             task.prepare(attachment)

@@ -38,6 +38,7 @@ class CxxCompilationTask(DistributedTask):
     cache_control: int  # 0 off, 1 on, 2 = refill (skip reads, still fill)
     compiler_digest: str
     compressed_source: bytes
+    ignore_timestamp_macros: bool = False
 
     def get_cache_setting(self) -> int:
         if self.cache_control in (self.CACHE_DISALLOW, self.CACHE_ALLOW,
@@ -68,6 +69,7 @@ class CxxCompilationTask(DistributedTask):
             invocation_arguments=self.invocation_arguments,
             compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD,
             disallow_cache_fill=self.cache_control <= 0,
+            ignore_timestamp_macros=self.ignore_timestamp_macros,
         )
         req.env_desc.compiler_digest = self.compiler_digest
         resp, _ = channel.call(
@@ -125,4 +127,5 @@ def make_cxx_task(msg: api.local.SubmitCxxTaskRequest,
         cache_control=msg.cache_control,
         compiler_digest=digest,
         compressed_source=compressed_source,
+        ignore_timestamp_macros=msg.ignore_timestamp_macros,
     )
