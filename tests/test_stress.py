@@ -281,10 +281,20 @@ def test_execution_engine_stability_stress(tmp_path):
     eng.stop()
     assert eng.inspect()["running"] == 0
     assert eng.tasks_run_ever > 16, "stress barely exercised the engine"
-    # No orphaned `sleep 30` from our engine may outlive stop().
+    # No orphaned `sleep 30` from our engine may outlive stop().  The
+    # pattern is anchored (exact cmdline): an unanchored match catches
+    # any unrelated process whose command line merely CONTAINS the
+    # string (e.g. the shell that launched this test).  A just-killed
+    # process also stays pgrep-visible until its waiter reaps it, so
+    # poll briefly before declaring a leak.
     import subprocess
-    out = subprocess.run(["pgrep", "-f", "sleep 30"], capture_output=True,
-                         text=True).stdout.split()
+    deadline = time.time() + 5
+    while True:
+        out = subprocess.run(["pgrep", "-f", "^sleep 30$"],
+                             capture_output=True, text=True).stdout.split()
+        if not out or time.time() > deadline:
+            break
+        time.sleep(0.1)
     assert not out, f"leaked subprocesses: {out}"
 
 

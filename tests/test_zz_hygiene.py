@@ -15,10 +15,13 @@ import threading
 
 
 def test_no_leaked_subprocesses():
-    # Our tests spawn `sleep 30` (stress), fake compilers, and real
-    # g++; anything still alive now escaped a stop()/kill path.
+    # Our tests spawn `sleep 30` (stress), fake compilers, and servant
+    # compile commands; anything still alive now escaped a stop()/kill
+    # path.  Patterns are anchored/specific so the shell that launched
+    # pytest (whose command line may quote these strings) never
+    # matches.
     out = subprocess.run(
-        ["pgrep", "-fa", "sleep 30|fake|output.o"],
+        ["pgrep", "-fa", r"^sleep 30$|/bin/g\+\+ .*output\.o"],
         capture_output=True, text=True).stdout
     leaked = [l for l in out.splitlines()
               if "pgrep" not in l and l.strip()]
