@@ -21,7 +21,7 @@ def test_no_leaked_subprocesses():
     # pytest (whose command line may quote these strings) never
     # matches.
     out = subprocess.run(
-        ["pgrep", "-fa", r"^sleep 30$|/bin/g\+\+ .*output\.o"],
+        ["pgrep", "-fa", r"^sleep [0-9.]+$|/bin/g\+\+ .*output\.o"],
         capture_output=True, text=True).stdout
     leaked = [l for l in out.splitlines()
               if "pgrep" not in l and l.strip()]

@@ -10,6 +10,7 @@ captured.
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 from typing import Optional
 
@@ -21,24 +22,29 @@ def start_program(
     cwd: str = "/",
     env: Optional[dict] = None,
 ) -> subprocess.Popen:
-    """Launch detached into its own process group; caller owns wait()."""
+    """Launch detached into its own process group; caller owns wait().
 
-    def pre_exec():  # runs in the child between fork and exec
-        os.setpgid(0, 0)
-        try:
-            os.nice(nice_level)
-        except OSError:
-            pass
-
+    Deliberately NO preexec_fn: the daemon process runs jax/grpc worker
+    threads, and running Python between fork and exec in a
+    multithreaded parent intermittently corrupts the child (observed as
+    segfaults under fork pressure).  `start_new_session` does the
+    setsid at the C level (a session leader is also a process-group
+    leader, so killpg(pid) still nukes the whole tree), and niceness
+    comes from the `nice` binary instead of os.nice in the child.
+    """
+    argv = ["/bin/sh", "-c", cmdline]
+    if nice_level:
+        nice_bin = shutil.which("nice")
+        if nice_bin:  # niceness is best-effort, never a hard dependency
+            argv = [nice_bin, "-n", str(nice_level)] + argv
     return subprocess.Popen(
-        ["/bin/sh", "-c", cmdline],
+        argv,
         cwd=cwd,
         env=env,
         stdin=subprocess.DEVNULL,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
-        preexec_fn=pre_exec,
-        start_new_session=False,
+        start_new_session=True,
     )
 
 

@@ -72,6 +72,16 @@ def make_fake_compiler(dir_path: str, compile_s: float = 0.0) -> str:
     return str(gxx)
 
 
+class _IdleSampler(LoadAverageSampler):
+    """A rig servant's 'machine' reports zero foreign load."""
+
+    def sample(self) -> None:
+        pass
+
+    def loadavg(self, n: int) -> int:
+        return 0
+
+
 class _Servant:
     def __init__(self, cluster: "LocalCluster", tmp: pathlib.Path,
                  index: int, max_concurrency: int,
@@ -91,10 +101,13 @@ class _Servant:
         self.config_keeper = ConfigKeeper(cluster.sched_uri, "")
         cache_writer = DistributedCacheWriter(
             cluster.cache_uri, self.config_keeper.serving_daemon_token)
-        # Synthetic nprocs: each rig servant plays a machine big enough
-        # to advertise `max_concurrency` slots regardless of this
-        # host's real core count (capped by max_remote_tasks above).
-        sampler = LoadAverageSampler(nprocs=max(4, max_concurrency * 3))
+        # Synthetic machine: big enough to advertise `max_concurrency`
+        # slots regardless of this host's real core count, and ALWAYS
+        # idle — N rig servants share one real box, and each would
+        # otherwise read the whole machine's load (the test workload
+        # itself!) as its own foreign load, collapsing every effective
+        # capacity to zero mid-run.
+        sampler = _IdleSampler(nprocs=max(4, max_concurrency * 3))
         self.service = DaemonService(
             config, engine=self.engine, registry=self.registry,
             cache_writer=cache_writer, sampler=sampler,
