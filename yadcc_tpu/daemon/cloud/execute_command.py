@@ -14,6 +14,9 @@ import shutil
 import subprocess
 from typing import Optional
 
+# Resolved once: start_program runs once per compile task.
+_NICE_BIN = shutil.which("nice")
+
 
 def start_program(
     cmdline: str,
@@ -33,10 +36,9 @@ def start_program(
     comes from the `nice` binary instead of os.nice in the child.
     """
     argv = ["/bin/sh", "-c", cmdline]
-    if nice_level:
-        nice_bin = shutil.which("nice")
-        if nice_bin:  # niceness is best-effort, never a hard dependency
-            argv = [nice_bin, "-n", str(nice_level)] + argv
+    if nice_level and _NICE_BIN:
+        # Best-effort niceness, never a hard dependency.
+        argv = [_NICE_BIN, "-n", str(nice_level)] + argv
     return subprocess.Popen(
         argv,
         cwd=cwd,

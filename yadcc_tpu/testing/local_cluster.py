@@ -187,7 +187,8 @@ class LocalCluster:
             dispatcher=self.delegate,
             port=http_port,
         )
-        self._extra_keepers: List[RunningTaskKeeper] = []
+        # Background keepers of extra delegates (anything with .stop()).
+        self._extra_keepers: List = []
         self.cache_reader.start()
         self.running_keeper.start()
         for servant in self.servants:
@@ -204,12 +205,15 @@ class LocalCluster:
     def make_extra_delegate(self) -> DistributedTaskDispatcher:
         """A second delegate, as another build machine would run: own
         grant keeper, own running-task snapshot, sharing only the
-        cluster services.  Caller-owned (not stopped by stop())."""
+        cluster services.  Its background keepers are torn down by
+        stop() along with the rest of the cluster."""
         keeper = RunningTaskKeeper(self.sched_uri, refresh_interval_s=0.5)
         keeper.start()
         self._extra_keepers.append(keeper)
+        grants = TaskGrantKeeper(self.sched_uri, "")
+        self._extra_keepers.append(grants)
         return DistributedTaskDispatcher(
-            grant_keeper=TaskGrantKeeper(self.sched_uri, ""),
+            grant_keeper=grants,
             config_keeper=self.config_keeper,
             cache_reader=self.cache_reader,
             running_task_keeper=keeper,

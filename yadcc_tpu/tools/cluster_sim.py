@@ -30,7 +30,8 @@ import numpy as np
 
 
 def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
-        policy: str, in_flight: int = 0, compile_s: float = 0.05) -> dict:
+        policy: str, in_flight: int = 0, compile_s: float = 0.05,
+        delegates: int = 1) -> dict:
     from ..common import compress
     from ..common.hashing import digest_bytes, digest_file
     from ..daemon.local.cxx_task import CxxCompilationTask
@@ -45,6 +46,12 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
         tmp, n_servants=servants, policy=policy,
         servant_concurrency=concurrency,
         compiler_dirs=[str(tmp / "bin")])
+    # Several "build machines": each extra delegate owns its own grant
+    # keeper and running-task snapshot, so duplicate TUs can join
+    # across machines (the cluster-wide dedup path).
+    delegates = max(1, delegates)
+    all_delegates = [cluster.delegate] + [
+        cluster.make_extra_delegate() for _ in range(delegates - 1)]
 
     rng = np.random.default_rng(1)
     n_unique = max(1, int(tasks * (1.0 - dup_rate)))
@@ -79,15 +86,16 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
     work = list(range(tasks))
 
     def submit_and_wait(i: int):
+        delegate = all_delegates[i % len(all_delegates)]
         t0 = time.perf_counter()
         # The real client retries infrastructure failures (negative
         # exit codes) up to 5 times before giving up — backpressure
         # under load is expected, not fatal (reference
         # yadcc-cxx.cc:191-248).
         for _ in range(5):
-            tid = cluster.delegate.queue_task(make_task(i))
-            result = cluster.delegate.wait_for_task(tid, timeout_s=120.0)
-            cluster.delegate.free_task(tid)
+            tid = delegate.queue_task(make_task(i))
+            result = delegate.wait_for_task(tid, timeout_s=120.0)
+            delegate.free_task(tid)
             if result is not None and result.exit_code >= 0:
                 break
         dt = time.perf_counter() - t0
@@ -121,9 +129,11 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
             return round(float(np.percentile(
                 np.array(latencies) * 1000, q)), 1)
 
-        stats = cluster.delegate.inspect()["stats"]
+        stats = {k: sum(d.inspect()["stats"][k] for d in all_delegates)
+                 for k in ("hit_cache", "reused", "actually_run", "failed")}
         return {
             "tasks": tasks,
+            "delegates": delegates,
             "servants": servants,
             "servant_concurrency": concurrency,
             "policy": policy,
@@ -132,9 +142,7 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
             "failures": len(failures),
             "p50_latency_ms": pctl(50),
             "p99_latency_ms": pctl(99),
-            "breakdown": {k: stats[k] for k in
-                          ("hit_cache", "reused", "actually_run",
-                           "failed")},
+            "breakdown": stats,
         }
     finally:
         cluster.stop()
@@ -146,10 +154,13 @@ def main() -> None:
     ap.add_argument("--servants", type=int, default=4)
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--dup-rate", type=float, default=0.2)
+    ap.add_argument("--delegates", type=int, default=1,
+                    help="simulated build machines (cross-machine dedup)")
     ap.add_argument("--policy", default="greedy_cpu")
     args = ap.parse_args()
     print(json.dumps(run(args.tasks, args.servants, args.concurrency,
-                         args.dup_rate, args.policy), indent=2))
+                         args.dup_rate, args.policy,
+                         delegates=args.delegates), indent=2))
 
 
 if __name__ == "__main__":
