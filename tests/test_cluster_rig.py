@@ -387,3 +387,26 @@ def test_scheduler_restart_recovers_from_heartbeats(tmp_path):
             new_dispatcher.stop()
     finally:
         cluster.stop()
+
+
+def test_cache_server_down_degrades_not_fails(tmp_path):
+    """The cache tier is an accelerator, not a dependency: with the
+    cache server gone, compiles must still succeed (no reads, no
+    fills, no hangs)."""
+    compiler = make_fake_compiler(str(tmp_path / "bin"))
+    cd = digest_file(compiler)
+    cluster = LocalCluster(tmp_path, n_servants=1, servant_concurrency=2,
+                           compiler_dirs=[str(tmp_path / "bin")])
+    try:
+        cluster.cache_server.stop(grace=0)
+        for i in range(3):
+            tid = cluster.delegate.queue_task(
+                make_task(cd, f"int nc{i}();".encode(), 1))
+            r = cluster.delegate.wait_for_task(tid, 60)
+            cluster.delegate.free_task(tid)
+            assert r is not None and r.exit_code == 0, \
+                "compile failed with the cache tier down"
+        stats = cluster.delegate.inspect()["stats"]
+        assert stats["actually_run"] == 3 and stats["failed"] == 0
+    finally:
+        cluster.stop()
