@@ -20,6 +20,41 @@ import time
 import numpy as np
 
 
+def _make_groups(rng, T, G, E_WORDS):
+    """One micro-batch's request groups; sizes sum exactly to T."""
+    envs = rng.integers(0, E_WORDS * 32, G)
+    sizes = np.full(G, T // G, np.int32)
+    sizes[: T % G] += 1
+    return [(int(e), 1, -1, int(m)) for e, m in zip(envs, sizes)]
+
+
+def _occupancy_trimmer(static, target: float = 0.55):
+    """Shared steady-state model: a closure retiring grants (the
+    FreeTask stream) so occupancy hovers around `target` — used
+    identically by the headline loop and both Pallas A/Bs so their
+    numbers stay comparable."""
+    import jax
+    import jax.numpy as jnp
+
+    capacity = np.asarray(static["capacity"])
+    alive = np.asarray(static["alive"])
+    total_capacity = int(capacity[alive].sum())
+
+    @jax.jit
+    def free_fraction(running, frac):
+        freed = (running.astype(jnp.float32) * frac).astype(jnp.int32)
+        return jnp.maximum(running - freed, 0)
+
+    def trim(running):
+        occ = int(jax.device_get(running.sum()))
+        extra = occ - target * total_capacity
+        if extra > 0:
+            return free_fraction(running, jnp.float32(extra / max(occ, 1)))
+        return running
+
+    return trim
+
+
 def main() -> None:
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
@@ -52,11 +87,6 @@ def main() -> None:
     G = int(os.environ.get("BENCH_GROUPS", 4))
     G_PAD = max(8, G)
 
-    def make_groups(i):
-        envs = rng.integers(0, E_WORDS * 32, G)
-        sizes = np.full(G, T // G, np.int32)
-        sizes[: T % G] += 1  # groups sum exactly to the reported T
-        return [(int(e), 1, -1, int(m)) for e, m in zip(envs, sizes)]
 
     # The pool lives on the device: static arrays (capacity, envs, ...)
     # upload once and change only on heartbeat deltas; `running` stays
@@ -73,22 +103,15 @@ def main() -> None:
     running = jnp.zeros(S, jnp.int32)
 
     # Steady state: the FreeTask stream retires roughly one grant per
-    # grant issued, so each cycle frees a fraction of every servant's
-    # load (trace_replay's `free` events do the same) — occupancy
-    # hovers around the target instead of sawtoothing to empty.
-    target_occupancy = 0.55
-    total_capacity = int(capacity[alive].sum())
-
-    @jax.jit
-    def free_fraction(running, frac):
-        freed = (running.astype(jnp.float32) * frac).astype(jnp.int32)
-        return jnp.maximum(running - freed, 0)
+    # grant issued (trim applied off the timed path) — occupancy hovers
+    # around the target instead of sawtoothing to empty.
+    trim = _occupancy_trimmer(static)
 
     granted = 0
     latencies = []
     start_all = None
     for i in range(WARMUP + BATCHES):
-        groups = make_groups(i)
+        groups = _make_groups(rng, T, G, E_WORDS)
         t0 = time.perf_counter()
         pool = asn.PoolArrays(running=running, **static)
         batch = asg.make_grouped_batch(groups, pad_to=G_PAD)
@@ -97,11 +120,7 @@ def main() -> None:
         t1 = time.perf_counter()
         # Untimed: retiring grants rides the FreeTask/heartbeat stream,
         # not the grant critical path.
-        occupancy = int(jax.device_get(running.sum()))
-        extra = occupancy - target_occupancy * total_capacity
-        if extra > 0:
-            running = free_fraction(
-                running, jnp.float32(extra / max(occupancy, 1)))
+        running = trim(running)
         if i < WARMUP:
             start_all = time.perf_counter()
             continue
@@ -119,16 +138,24 @@ def main() -> None:
     # backlog per cycle (BASELINE "p99 @5k workers" scenario).
     disp_per_sec = _dispatcher_cycle_throughput()
 
-    # On real TPU hardware, also record the Pallas-vs-grouped A/B (the
+    # On real TPU hardware, also record the Pallas A/Bs (the
     # native-compile validation a CPU run can't provide): same pool,
-    # same workload, parity-checked, then timed.
+    # same workload, parity-checked, then timed.  pallas_grouped is the
+    # flagship single-launch variant of the headline kernel — directly
+    # comparable numbers.
     pallas = None
+    pallas_grouped = None
     if jax.devices()[0].platform == "tpu" \
             and not os.environ.get("BENCH_SKIP_PALLAS"):
         try:
             pallas = _pallas_ab(static, S, T, E_WORDS, rng)
         except Exception as e:  # Mosaic lowering is unproven on HW
             pallas = {"error": f"{type(e).__name__}: {e}"[:300]}
+        try:
+            pallas_grouped = _pallas_grouped_ab(static, S, T, E_WORDS,
+                                                G, G_PAD, rng)
+        except Exception as e:
+            pallas_grouped = {"error": f"{type(e).__name__}: {e}"[:300]}
     print(json.dumps({
         "metric": "scheduler_assignments_per_sec_5k_workers",
         "value": round(per_sec, 1),
@@ -140,6 +167,7 @@ def main() -> None:
         "kernel": "grouped",
         "dispatcher_grants_per_sec": disp_per_sec,
         "pallas_ab": pallas,
+        "pallas_grouped_ab": pallas_grouped,
         "device": str(jax.devices()[0]),
         # A CPU number must never masquerade as a TPU number.
         "cpu_fallback": bool(os.environ.get("BENCH_FORCE_CPU")),
@@ -167,16 +195,9 @@ def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
         np.array_equal(np.asarray(p_picks), np.asarray(s_picks))
         and np.array_equal(np.asarray(p_running), np.asarray(s_running)))
 
-    # Same steady-state shape as the headline loop: thread `running`
-    # through and retire a fraction off the timed path, so the two
-    # numbers are comparable at the same ~55% occupancy.
-    @jax.jit
-    def free_fraction(r, frac):
-        return jnp.maximum(
-            r - (r.astype(jnp.float32) * frac).astype(jnp.int32), 0)
-
-    total_capacity = int(np.asarray(static["capacity"])[
-        np.asarray(static["alive"])].sum())
+    # Same steady-state shape as the headline loop (shared trimmer), so
+    # the two numbers are comparable at the same ~55% occupancy.
+    trim = _occupancy_trimmer(static)
     granted = 0
     t0 = time.perf_counter()
     elapsed = 0.0
@@ -186,15 +207,54 @@ def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
         p_picks.block_until_ready()
         elapsed += time.perf_counter() - t0
         granted += int((np.asarray(p_picks) >= 0).sum())
-        occ = int(np.asarray(running).sum())
-        extra = occ - 0.55 * total_capacity
-        if extra > 0:
-            running = free_fraction(running,
-                                    jnp.float32(extra / max(occ, 1)))
+        running = trim(running)
         t0 = time.perf_counter()
     return {
         "native_compile_ok": True,
         "parity_with_scan_kernel": parity,
+        "assignments_per_sec": round(granted / elapsed, 1),
+    }
+
+
+def _pallas_grouped_ab(static, S, T, E_WORDS, G, G_PAD, rng,
+                       batches: int = 30) -> dict:
+    """The headline grouped workload through the single-launch Pallas
+    kernel: parity vs the XLA grouped kernel, then timed at the same
+    steady-state occupancy."""
+    import jax
+    import jax.numpy as jnp
+
+    from yadcc_tpu.ops import assignment as asn
+    from yadcc_tpu.ops import assignment_grouped as asg
+    from yadcc_tpu.ops.pallas_grouped import pallas_assign_grouped
+
+    running = jnp.zeros(S, jnp.int32)
+    pool = asn.PoolArrays(running=running, **static)
+    batch = asg.make_grouped_batch(_make_groups(rng, T, G, E_WORDS),
+                                   pad_to=G_PAD)
+    p_counts, p_running = pallas_assign_grouped(pool, batch)  # compiles
+    x_counts, x_running = asg.assign_grouped(pool, batch)
+    parity = bool(
+        np.array_equal(np.asarray(p_counts), np.asarray(x_counts))
+        and np.array_equal(np.asarray(p_running), np.asarray(x_running)))
+
+    trim = _occupancy_trimmer(static)
+    granted = 0
+    elapsed = 0.0
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        batch = asg.make_grouped_batch(_make_groups(rng, T, G, E_WORDS),
+                                       pad_to=G_PAD)
+        counts, running = pallas_assign_grouped(
+            asn.PoolArrays(running=running, **static), batch)
+        counts.block_until_ready()
+        elapsed += time.perf_counter() - t0
+        granted += int(np.asarray(counts).sum())
+        running = trim(running)
+        t0 = time.perf_counter()
+    return {
+        "native_compile_ok": True,
+        "parity_with_xla_grouped": parity,
         "assignments_per_sec": round(granted / elapsed, 1),
     }
 

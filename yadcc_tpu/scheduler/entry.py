@@ -33,7 +33,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--inspect-credential", default="")
     p.add_argument("--dispatch-policy", default="auto",
                    choices=["auto", "greedy_cpu", "jax_batched",
-                            "jax_grouped", "jax_pallas", "jax_sharded"],
+                            "jax_grouped", "jax_pallas",
+                            "jax_pallas_grouped", "jax_sharded"],
                    help="auto = host greedy under 16 waiters, grouped "
                         "device kernel above (the measured winner, "
                         "artifacts/trace_ab.json)")

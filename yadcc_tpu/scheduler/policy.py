@@ -222,6 +222,11 @@ class JaxGroupedPolicy(DispatchPolicy):
         self._max_groups = max_groups
         self._pool_cache = _DevicePoolCache()
 
+    def _run_grouped_kernel(self, pool, batch):
+        from ..ops import assignment_grouped as asg
+
+        return asg.assign_grouped(pool, batch, self._cm)
+
     def assign(self, snap, requests):
         from ..ops import assignment_grouped as asg
 
@@ -249,9 +254,8 @@ class JaxGroupedPolicy(DispatchPolicy):
             batch = asg.make_grouped_batch(
                 [(k[0], k[1], k[2], len(m)) for k, m in chunk],
                 pad_to=pad)
-            counts, new_running = asg.assign_grouped(
-                _upload_pool(snap, running, self._pool_cache), batch,
-                self._cm)
+            counts, new_running = self._run_grouped_kernel(
+                _upload_pool(snap, running, self._pool_cache), batch)
             counts = np.asarray(counts)
             running = np.asarray(new_running)
             # Expand (group, slot)->count into per-request picks with
@@ -298,6 +302,24 @@ class JaxShardedPolicy(JaxBatchedPolicy):
 
     def _run_kernel(self, pool, batch):
         return self._fn(pool, batch)
+
+
+class JaxPallasGroupedPolicy(JaxGroupedPolicy):
+    """JaxGroupedPolicy semantics through the single-pallas-call grouped
+    kernel (ops/pallas_grouped.py): the whole batch's threshold
+    searches run in one launch with the pool pinned in VMEM.  Compiles
+    natively on TPU; interpreter elsewhere (parity testing only)."""
+
+    name = "jax_pallas_grouped"
+
+    def _run_grouped_kernel(self, pool, batch):
+        import jax
+
+        from ..ops.pallas_grouped import pallas_assign_grouped
+
+        interpret = jax.devices()[0].platform != "tpu"
+        return pallas_assign_grouped(pool, batch, self._cm,
+                                     interpret=interpret)
 
 
 class JaxPallasPolicy(JaxBatchedPolicy):
@@ -366,6 +388,8 @@ def make_policy(name: str, max_servants: int,
         return JaxPallasPolicy(max_servants, cost_model=cm)
     if name == "jax_sharded":
         return JaxShardedPolicy(max_servants, cost_model=cm)
+    if name == "jax_pallas_grouped":
+        return JaxPallasGroupedPolicy(cost_model=cm)
     if name == "auto":
         return AutoPolicy(cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")
