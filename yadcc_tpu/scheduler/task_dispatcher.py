@@ -645,6 +645,10 @@ class TaskDispatcher:
                 }
             return {
                 "policy": self._policy.name,
+                # Device policies cache static pool arrays keyed on
+                # this; a rapidly-advancing epoch with a stable fleet
+                # means something is churning servant statics.
+                "pool_epoch": self._pool_epoch,
                 "servants": servants,
                 "grants_outstanding": len(self._grants),
                 "zombies": sum(1 for g in self._grants.values()
