@@ -137,6 +137,7 @@ def main() -> None:
     # phase — not just the raw kernel.  5000 live servants, 512-request
     # backlog per cycle (BASELINE "p99 @5k workers" scenario).
     disp_per_sec = _dispatcher_cycle_throughput()
+    beats_per_sec = _heartbeat_throughput()
 
     # On real TPU hardware, also record the Pallas A/Bs (the
     # native-compile validation a CPU run can't provide): same pool,
@@ -166,12 +167,50 @@ def main() -> None:
         "pool_size": S,
         "kernel": "grouped",
         "dispatcher_grants_per_sec": disp_per_sec,
+        "heartbeats_per_sec": beats_per_sec,
         "pallas_ab": pallas,
         "pallas_grouped_ab": pallas_grouped,
         "device": str(jax.devices()[0]),
         # A CPU number must never masquerade as a TPU number.
         "cpu_fallback": bool(os.environ.get("BENCH_FORCE_CPU")),
     }))
+
+
+def _heartbeat_throughput(n_servants: int = 5000, n: int = 10000) -> float:
+    """Heartbeat-handler calls/sec with a full registry — the other
+    half of scheduler load (a 5k fleet beats at 5k/s; this shows the
+    headroom)."""
+    from yadcc_tpu import api
+    from yadcc_tpu.rpc.transport import RpcContext
+    from yadcc_tpu.scheduler.policy import GreedyCpuPolicy
+    from yadcc_tpu.scheduler.service import SchedulerService
+    from yadcc_tpu.scheduler.task_dispatcher import TaskDispatcher
+    from yadcc_tpu.utils.clock import VirtualClock
+
+    d = TaskDispatcher(GreedyCpuPolicy(), max_servants=8192, max_envs=256,
+                       clock=VirtualClock(0), batch_window_s=0.0,
+                       start_dispatch_thread=False)
+    svc = SchedulerService(d)
+
+    def beat(i):
+        req = api.scheduler.HeartbeatRequest(
+            token="", version=1,
+            location=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}:8335",
+            capacity=16, num_processors=32,
+            memory_available_in_bytes=64 << 30,
+            next_heartbeat_in_ms=10000)
+        req.env_descs.add(compiler_digest=f"env{i % 64}")
+        svc.Heartbeat(req, b"", RpcContext(
+            peer=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}:999"))
+
+    for i in range(n_servants):
+        beat(i)
+    t0 = time.perf_counter()
+    for k in range(n):
+        beat(k % n_servants)
+    dt = time.perf_counter() - t0
+    d.stop()
+    return round(n / dt, 1)
 
 
 def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
