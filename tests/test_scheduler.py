@@ -467,3 +467,29 @@ def test_dispatch_thread_survives_policy_exception():
     finally:
         d.stop()
 
+
+
+def test_auto_policy_adaptive_crossover():
+    """The greedy/device route depends on pool size: a lone request is
+    always greedy; at a 5000-slot pool even a couple of requests take
+    the kernel (the host scan is O(S) per request)."""
+    import numpy as np
+
+    from yadcc_tpu.scheduler.policy import AutoPolicy, PoolSnapshot
+
+    def snap(s):
+        return PoolSnapshot(
+            alive=np.ones(s, bool), capacity=np.full(s, 4, np.int32),
+            running=np.zeros(s, np.int32), dedicated=np.zeros(s, bool),
+            version=np.ones(s, np.int32),
+            env_bitmap=np.full((s, 8), 0xFFFFFFFF, np.uint32))
+
+    auto = AutoPolicy()
+    assert auto._use_greedy(snap(128), 1)
+    assert auto._use_greedy(snap(128), 5)
+    assert not auto._use_greedy(snap(128), 16)
+    assert auto._use_greedy(snap(5120), 1)
+    assert not auto._use_greedy(snap(5120), 3)
+    # Explicit override still wins.
+    fixed = AutoPolicy(device_threshold=100)
+    assert fixed._use_greedy(snap(5120), 99)
