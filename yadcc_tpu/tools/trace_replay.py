@@ -132,6 +132,15 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
             policies["jax_sharded"] = JaxShardedPolicy(max_servants=s)
         except ValueError:
             pass
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            # Native-compiled Pallas variants join the panel on real
+            # hardware (the interpreter would be minutes-slow on CPU;
+            # its parity is covered by the unit tests instead).
+            from ..scheduler.policy import JaxPallasGroupedPolicy
+
+            policies["jax_pallas_grouped"] = JaxPallasGroupedPolicy()
 
     results = {}
     reference_outcomes = None
