@@ -76,6 +76,6 @@ def test_both_attempts_hang_gives_bounded_failure(tmp_path):
     r = run_tool(tmp_path, """
         import time
         time.sleep(60)   # wedged even on CPU
-    """, timeout=20)
+    """, env_extra={"YTPU_DEVICE_CPU_TIMEOUT": "3"}, timeout=20)
     assert r.returncode == 3
     assert "no backend produced a result" in r.stderr

@@ -56,6 +56,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def cache_server_start(args) -> None:
+    from ..utils.locktrace import install_from_env
+
+    install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
     if args.cache_engine == "disk":
         l2 = make_engine("disk", dirs=args.cache_dirs,
                          capacity=parse_size(args.l2_capacity))

@@ -87,6 +87,9 @@ def _guess_local_ip(scheduler_uri: str) -> str:
 
 
 def daemon_start(args) -> None:
+    from ..utils.locktrace import install_from_env
+
+    install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
     for var in _SCRUBBED_ENV:
         os.environ.pop(var, None)
     if not args.no_privilege_drop:

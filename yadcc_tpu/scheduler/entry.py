@@ -53,6 +53,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def scheduler_start(args) -> None:
     from ..common.parse_size import parse_size
+    from ..utils.locktrace import install_from_env
+
+    install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
 
     policy = make_policy(args.dispatch_policy, args.max_servants,
                          avoid_self=not args.allow_self_dispatch)

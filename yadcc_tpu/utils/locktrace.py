@@ -1,0 +1,291 @@
+"""Lock-order tracing: the race-detection test tier.
+
+The reference runs every test under gperftools *strict* heap checking
+(BLADE_ROOT:25-33) and keeps concurrency honest by convention
+(`Unsafe*` naming for lock-held methods, documented lock ordering,
+task_dispatcher.h:226-268).  CPython has no TSan, so this module makes
+the lock-ordering convention *checkable*: while installed, every
+`threading.Lock()` / `threading.RLock()` the framework constructs is
+wrapped in a traced proxy, and every acquisition records an edge from
+each lock the acquiring thread already holds to the new one.  A cycle
+in that order graph is a potential-deadlock (ABBA) pattern even if the
+interleaving never actually deadlocked during the run — the same
+happens-before generalization TSan's lock-order checker uses.
+
+Usage (tests — see tests/test_locktrace.py):
+
+    with locktrace.installed() as graph:
+        ... construct components, hammer them from threads ...
+    assert graph.violations == []
+
+Production opt-in (mirrors heap_check being baked into the reference's
+test config): set YTPU_LOCKTRACE=1 before starting any entry point and
+violations are logged once to stderr; `inspect()` surfaces them.
+
+Scope notes:
+- Installation swaps the *factories* on the `threading` module, so only
+  locks constructed while installed are traced; locks created by other
+  libraries during that window are traced too, which is harmless (they
+  simply add nodes) but keeps the window small in tests.
+- `threading.Condition` works with traced locks: it duck-types on
+  acquire/release and falls back to `acquire(0)`-probing for
+  `_is_owned`, both of which the proxy provides.
+- Overhead is one dict update per acquire on a per-thread structure and
+  one bounded graph probe per *new* edge, so stress tests stay fast.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+
+class LockGraph:
+    """Directed lock-order graph with immediate cycle detection."""
+
+    def __init__(self) -> None:
+        self._g = _real_lock()  # guards the graph itself (never traced)
+        self._edges: Dict[str, Set[str]] = {}
+        self._edge_sites: Dict[Tuple[str, str], str] = {}
+        self.violations: List[str] = []
+        self._reported: Set[Tuple[str, ...]] = set()
+        self._tls = threading.local()
+
+    # -- per-thread held stack ------------------------------------------
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    # -- events ----------------------------------------------------------
+
+    def note_acquired(self, name: str, site: str) -> None:
+        held = self._held()
+        if held:
+            with self._g:
+                for prev in held:
+                    if prev == name:   # RLock re-entry: no new edge
+                        continue
+                    succ = self._edges.setdefault(prev, set())
+                    if name not in succ:
+                        succ.add(name)
+                        self._edge_sites[(prev, name)] = site
+                        cycle = self._find_cycle_locked(name, prev)
+                        if cycle is not None:
+                            self._report_locked(cycle)
+        held.append(name)
+
+    def note_released(self, name: str) -> None:
+        held = self._held()
+        # Remove the most recent matching entry: release order need not
+        # be LIFO (that by itself is not a violation).
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # -- cycle machinery (graph lock held) -------------------------------
+
+    def _find_cycle_locked(self, src: str, dst: str
+                           ) -> Optional[List[str]]:
+        """Path src->...->dst would close a cycle with the new dst->src
+        edge; returns the node list if one exists."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _report_locked(self, cycle: List[str]) -> None:
+        key = tuple(sorted(cycle))
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        hops = []
+        for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+            site = self._edge_sites.get((a, b), "?")
+            hops.append(f"{a} -> {b} (at {site})")
+        self.violations.append(
+            "lock-order cycle: " + "; ".join(hops))
+
+    def inspect(self) -> dict:
+        with self._g:
+            return {
+                "locks": sorted(
+                    set(self._edges) | {b for s in self._edges.values()
+                                        for b in s}),
+                "edges": sum(len(s) for s in self._edges.values()),
+                "violations": list(self.violations),
+            }
+
+
+class _TracedLock:
+    """Proxy satisfying the Lock/RLock duck type, reporting to a graph."""
+
+    def __init__(self, graph: LockGraph, name: str, rlock: bool):
+        self._inner = _real_rlock() if rlock else _real_lock()
+        self._graph = graph
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            site = _caller_site()
+            self._graph.note_acquired(self._name, site)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # threading.Condition probes these when present (RLock only).
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _acquire_restore(self, state):
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._graph.note_acquired(self._name, "condition-reacquire")
+
+    def _release_save(self):
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            state = inner._release_save()
+        else:
+            inner.release()
+            state = None
+        self._graph.note_released(self._name)
+        return state
+
+    def __repr__(self):
+        return f"<TracedLock {self._name} {self._inner!r}>"
+
+
+def _caller_site(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    # Walk out of this module's own frames (acquire/__enter__).
+    while f and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if not f:
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+_serial = [0]
+
+
+def _name_from_site() -> str:
+    """Name a lock by construction site + per-instance serial: the site
+    makes violation reports self-describing, the serial keeps distinct
+    locks distinct nodes (two locks born on one line — e.g. striped or
+    comprehension-built — must not collapse into a single node, which
+    would both hide real inter-instance cycles and mislabel them as
+    re-entry)."""
+    f = sys._getframe(2)
+    while f and f.f_globals.get("__name__") in (__name__, "threading"):
+        f = f.f_back
+    _serial[0] += 1
+    if not f:
+        return f"anonymous#{_serial[0]}"
+    mod = f.f_globals.get("__name__", "?")
+    return f"{mod}:{f.f_lineno}#{_serial[0]}"
+
+
+_active: Optional[LockGraph] = None
+
+
+def install() -> LockGraph:
+    """Swap threading.Lock/RLock for traced factories. Returns the graph."""
+    global _active
+    if _active is not None:
+        return _active
+    graph = LockGraph()
+    _active = graph
+
+    def make_lock():
+        return _TracedLock(graph, _name_from_site(), rlock=False)
+
+    def make_rlock():
+        return _TracedLock(graph, _name_from_site(), rlock=True)
+
+    threading.Lock = make_lock          # type: ignore[misc]
+    threading.RLock = make_rlock        # type: ignore[misc]
+    return graph
+
+
+def uninstall() -> None:
+    global _active
+    threading.Lock = _real_lock         # type: ignore[misc]
+    threading.RLock = _real_rlock       # type: ignore[misc]
+    _active = None
+
+
+def active_graph() -> Optional[LockGraph]:
+    return _active
+
+
+@contextlib.contextmanager
+def installed():
+    graph = install()
+    try:
+        yield graph
+    finally:
+        uninstall()
+
+
+def install_from_env() -> Optional[LockGraph]:
+    """Entry-point hook: YTPU_LOCKTRACE=1 turns tracing on for the whole
+    process and registers an atexit report (the production analogue of
+    the reference's always-on strict heap check in tests)."""
+    if not os.environ.get("YTPU_LOCKTRACE"):
+        return None
+    graph = install()
+
+    from . import exposed_vars
+
+    exposed_vars.expose("yadcc/locktrace", graph.inspect)
+
+    import atexit
+
+    def report():
+        if graph.violations:
+            sys.stderr.write(
+                "locktrace: %d violation(s):\n  %s\n"
+                % (len(graph.violations),
+                   "\n  ".join(graph.violations)))
+
+    atexit.register(report)
+    return graph
