@@ -85,7 +85,7 @@ def main() -> None:
     # environments (one build floods one env); the grouped kernel
     # resolves each group with one parallel threshold search.
     G = int(os.environ.get("BENCH_GROUPS", 4))
-    G_PAD = max(8, G)
+    G_PAD = asg.group_pad(G)  # the exact shape policy production uses
 
 
     # The pool lives on the device: static arrays (capacity, envs, ...)

@@ -79,3 +79,17 @@ def test_both_attempts_hang_gives_bounded_failure(tmp_path):
     """, env_extra={"YTPU_DEVICE_CPU_TIMEOUT": "3"}, timeout=20)
     assert r.returncode == 3
     assert "no backend produced a result" in r.stderr
+
+
+def test_preset_forced_cpu_honors_explicit_timeout(tmp_path):
+    """An operator who preset YTPU_FORCE_CPU keeps their own bound:
+    the 60s CPU floor exists for the automatic rescue retry only."""
+    import time
+
+    t0 = time.monotonic()
+    r = run_tool(tmp_path, """
+        import time
+        time.sleep(60)   # exceeds the explicit 2s bound
+    """, env_extra={"YTPU_FORCE_CPU": "1"}, timeout=30)
+    assert r.returncode == 3
+    assert time.monotonic() - t0 < 15

@@ -143,3 +143,120 @@ def test_inspect_surface():
     assert snap["edges"] == 1
     assert snap["violations"] == []
     assert len(snap["locks"]) == 2
+
+
+def test_nested_install_restores_ambient_tracing():
+    """A scoped installed() inside an already-traced process must give
+    a fresh graph and hand tracing back on exit."""
+    outer = locktrace.install()
+    try:
+        lock_a = threading.Lock()
+        with lock_a:
+            pass
+        with locktrace.installed() as inner:
+            assert inner is not outer
+            assert inner.violations == []      # no inherited state
+            b = threading.Lock()
+            c = threading.Lock()
+            with b:
+                with c:
+                    pass
+            with c:
+                with b:
+                    pass
+            assert len(inner.violations) == 1
+        # Ambient layer restored: new locks report to `outer` again.
+        assert locktrace.active_graph() is outer
+        d = threading.Lock()
+        with lock_a:
+            with d:
+                pass
+        assert outer.violations == []
+    finally:
+        locktrace.uninstall()
+    assert locktrace.active_graph() is None
+
+
+def test_cross_thread_release_repairs_acquirer_stack():
+    """threading.Lock may be released by a different thread (handoff);
+    the acquirer's held stack must not keep a phantom entry that would
+    manufacture false cycles."""
+    with locktrace.installed() as g:
+        lock = threading.Lock()
+        other = threading.Lock()
+        acquired = threading.Event()
+        release_now = threading.Event()
+
+        def acquirer():
+            lock.acquire()
+            acquired.set()
+            release_now.wait(5)
+            # This thread continues WITHOUT holding `lock`: if the
+            # cross-thread release below failed to repair this
+            # thread's stack, the next acquisitions would record
+            # bogus lock->X edges.
+            with other:
+                pass
+
+        t = threading.Thread(target=acquirer, daemon=True)
+        t.start()
+        assert acquired.wait(5)
+        lock.release()          # handoff release from the main thread
+        with other:             # other->lock would now close a false
+            with lock:          # cycle if the phantom entry survived
+                pass
+        release_now.set()
+        t.join(timeout=5)
+    assert g.violations == [], g.violations
+
+
+def test_gc_prunes_forgotten_locks():
+    import gc
+
+    with locktrace.installed() as g:
+        keep = threading.Lock()
+        tmp = threading.Lock()
+        with keep:
+            with tmp:
+                pass
+        assert g.inspect()["edges"] == 1
+        del tmp
+        gc.collect()
+        probe = threading.Lock()   # drains the GC queue on acquire
+        with probe:
+            pass
+        assert g.inspect()["edges"] == 0
+    assert g.violations == []
+
+
+def test_same_line_concurrent_locks_stay_distinct():
+    """Serial allocation is atomic: locks born concurrently on one
+    source line must get distinct node names."""
+    with locktrace.installed():
+        out = []
+        barrier = threading.Barrier(8)
+
+        def born():
+            barrier.wait()
+            out.append(threading.Lock())   # same construction line x8
+
+        ts = [threading.Thread(target=born) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(5)
+        names = {l._name for l in out}
+        assert len(names) == 8, names
+
+
+def test_rlock_reentry_with_intermediate_lock_is_clean():
+    """`with r: with a: with r:` is legal (re-acquiring an owned RLock
+    cannot deadlock) and must not be reported as a cycle."""
+    with locktrace.installed() as g:
+        r = threading.RLock()
+        a = threading.Lock()
+        with r:
+            with a:
+                with r:
+                    pass
+    assert g.violations == [], g.violations

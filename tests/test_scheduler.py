@@ -493,3 +493,60 @@ def test_auto_policy_adaptive_crossover():
     # Explicit override still wins.
     fixed = AutoPolicy(device_threshold=100)
     assert fixed._use_greedy(snap(5120), 99)
+
+
+def test_small_max_envs_gets_one_bitmap_word():
+    """max_envs < 32 used to floor to a zero-width env bitmap and
+    IndexError on the first heartbeat."""
+    d = TaskDispatcher(GreedyCpuPolicy(), max_servants=8, max_envs=16,
+                       clock=VirtualClock(0), batch_window_s=0.0,
+                       start_dispatch_thread=False)
+    assert d.keep_servant_alive(make_servant("10.0.0.1:8335"), 30.0)
+    d.run_dispatch_cycle_for_testing()
+    d.stop()
+
+
+def test_entry_probe_failure_forces_cpu():
+    """A wedged accelerator must not freeze the dispatch thread: on a
+    failed probe the entry forces the CPU host platform, labels it in
+    /inspect, and granting stays live."""
+    import jax
+
+    from yadcc_tpu.scheduler import entry
+    from yadcc_tpu.utils import exposed_vars
+
+    prior = jax.config.jax_platforms
+    try:
+        forced = entry.ensure_policy_backend(
+            "jax_grouped", probe=lambda t: False)
+        assert forced is True
+        assert jax.config.jax_platforms == "cpu"
+        snap = exposed_vars.collect("yadcc/policy_platform")
+        assert snap["yadcc"]["policy_platform"]["forced_cpu"] is True
+    finally:
+        exposed_vars.unexpose("yadcc/policy_platform")
+        jax.config.update("jax_platforms", prior)
+
+
+def test_entry_probe_timeout_and_success_paths(monkeypatch):
+    """_probe_device_backend: TimeoutExpired -> False, healthy child ->
+    True — hermetic (no real jax subprocess: on the wedged hosts this
+    feature targets, a live probe would block the whole suite)."""
+    import subprocess
+
+    from yadcc_tpu.scheduler import entry
+
+    def wedged(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", wedged)
+    assert entry._probe_device_backend(0.1) is False
+
+    def healthy(*a, **kw):
+        return subprocess.CompletedProcess(a, 0, stdout="ok\n", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", healthy)
+    assert entry._probe_device_backend(0.1) is True
+    # greedy_cpu never probes at all.
+    assert entry.ensure_policy_backend(
+        "greedy_cpu", probe=lambda t: False) is False

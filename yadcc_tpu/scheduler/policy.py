@@ -242,15 +242,7 @@ class JaxGroupedPolicy(DispatchPolicy):
         running = snap.running.copy()
         for start in range(0, len(runs), self._max_groups):
             chunk = runs[start : start + self._max_groups]
-            # Pad to the next power of two, not max_groups: a typical
-            # micro-batch has a handful of runs, and the kernel's cost
-            # scales with the PADDED group count (each group is a full
-            # threshold search).  Power-of-two padding keeps the set of
-            # compiled shapes tiny (8/16/32/64) while cutting ~8x dead
-            # work off the common case.
-            pad = 8
-            while pad < len(chunk):
-                pad *= 2
+            pad = asg.group_pad(len(chunk))
             batch = asg.make_grouped_batch(
                 [(k[0], k[1], k[2], len(m)) for k, m in chunk],
                 pad_to=pad)

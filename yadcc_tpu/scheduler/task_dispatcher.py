@@ -120,7 +120,10 @@ class TaskDispatcher:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._envs = EnvRegistry(max_envs)
-        self._env_words = max_envs // 32
+        # Round UP: max_envs below 32 must still get one bitmap word
+        # (integer floor gave a zero-width bitmap and an IndexError on
+        # the first heartbeat).
+        self._env_words = (max_envs + 31) // 32
 
         self._slots: List[Optional[_Servant]] = [None] * max_servants
         self._free_slots = list(range(max_servants - 1, -1, -1))

@@ -57,25 +57,30 @@ def guard_device_entry(main, *, module: str = "",
     argv = ([sys.executable, "-m", module, *sys.argv[1:]] if module
             else [sys.executable, *sys.argv])
     timeout = int(os.environ.get(timeout_env, default_timeout_s))
-    # The forced-CPU fallback is not subject to the tunnel wedge being
-    # dodged, but it does pay interpreter + jax-import startup on a
-    # possibly loaded machine — give it its own floor so a tight device
-    # timeout can't kill the very attempt meant to rescue the run.
+    # The AUTOMATIC forced-CPU fallback is not subject to the tunnel
+    # wedge being dodged, but it does pay interpreter + jax-import
+    # startup on a possibly loaded machine — give it its own floor so a
+    # tight device timeout can't kill the very attempt meant to rescue
+    # the run.  An operator who preset YTPU_FORCE_CPU themselves keeps
+    # their explicit timeout: the floor exists for the rescue retry,
+    # not to second-guess a deliberately bounded CPU-only run.
     cpu_timeout = int(os.environ.get("YTPU_DEVICE_CPU_TIMEOUT",
                                      max(timeout, 60)))
+    preset_forced = bool(os.environ.get("YTPU_FORCE_CPU"))
     base_env = dict(os.environ, **{_CHILD_MARKER: "1"})
     attempts = [base_env]
-    if not os.environ.get("YTPU_FORCE_CPU"):
+    if not preset_forced:
         attempts.append(dict(base_env, YTPU_FORCE_CPU="1"))
     for env in attempts:
         forced = bool(env.get("YTPU_FORCE_CPU"))
+        rescue = forced and not preset_forced
         try:
             r = subprocess.run(argv, env=env,
-                               timeout=cpu_timeout if forced else timeout)
+                               timeout=cpu_timeout if rescue else timeout)
         except subprocess.TimeoutExpired:
             sys.stderr.write(
                 f"device-guard: attempt {'(forced CPU) ' if forced else ''}"
-                f"timed out after {cpu_timeout if forced else timeout}s\n")
+                f"timed out after {cpu_timeout if rescue else timeout}s\n")
             continue
         if forced and r.returncode == 0:
             sys.stderr.write(

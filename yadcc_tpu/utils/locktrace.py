@@ -47,50 +47,108 @@ _real_rlock = threading.RLock
 
 
 class LockGraph:
-    """Directed lock-order graph with immediate cycle detection."""
+    """Directed lock-order graph with immediate cycle detection.
+
+    All state (including per-thread held stacks) lives under one
+    internal real lock: held stacks are keyed by thread id rather than
+    thread-local so a handoff-style release from a *different* thread
+    (legal for threading.Lock, used by stdlib internals) can repair the
+    acquirer's stack instead of leaving a phantom entry that would
+    manufacture false cycles.  Growth is bounded: a proxy's GC prunes
+    its node, and a hard edge cap saturates the graph (reported in
+    inspect()) rather than letting the cycle probe degrade forever in
+    a long-lived traced process."""
+
+    MAX_EDGES = 100_000
 
     def __init__(self) -> None:
+        import collections
+
         self._g = _real_lock()  # guards the graph itself (never traced)
         self._edges: Dict[str, Set[str]] = {}
         self._edge_sites: Dict[Tuple[str, str], str] = {}
         self.violations: List[str] = []
         self._reported: Set[Tuple[str, ...]] = set()
-        self._tls = threading.local()
-
-    # -- per-thread held stack ------------------------------------------
-
-    def _held(self) -> List[str]:
-        held = getattr(self._tls, "held", None)
-        if held is None:
-            held = self._tls.held = []
-        return held
+        self._stacks: Dict[int, List[str]] = {}
+        self._n_edges = 0
+        self.saturated = False
+        # GC'd proxies queue their names here (deque.append is atomic,
+        # so __del__ — which can fire mid-note_acquired via GC — never
+        # touches _g); pruning happens at the next traced event.
+        self._dead = collections.deque()
 
     # -- events ----------------------------------------------------------
 
     def note_acquired(self, name: str, site: str) -> None:
-        held = self._held()
-        if held:
-            with self._g:
-                for prev in held:
-                    if prev == name:   # RLock re-entry: no new edge
-                        continue
-                    succ = self._edges.setdefault(prev, set())
-                    if name not in succ:
-                        succ.add(name)
-                        self._edge_sites[(prev, name)] = site
-                        cycle = self._find_cycle_locked(name, prev)
-                        if cycle is not None:
-                            self._report_locked(cycle)
-        held.append(name)
-
-    def note_released(self, name: str) -> None:
-        held = self._held()
-        # Remove the most recent matching entry: release order need not
-        # be LIFO (that by itself is not a violation).
-        for i in range(len(held) - 1, -1, -1):
-            if held[i] == name:
-                del held[i]
+        tid = threading.get_ident()
+        with self._g:
+            while self._dead:
+                self._forget_locked(self._dead.popleft())
+            held = self._stacks.setdefault(tid, [])
+            if name in held:
+                # RLock re-entry: re-acquiring an owned lock can never
+                # deadlock, so it adds NO ordering constraint — not
+                # even from other locks acquired in between (recording
+                # held->name here would turn the legal pattern
+                # `with r: with a: with r:` into a bogus cycle).
+                held.append(name)
                 return
+            for prev in held:
+                succ = self._edges.setdefault(prev, set())
+                if name not in succ:
+                    if self._n_edges >= self.MAX_EDGES:
+                        self.saturated = True
+                        continue
+                    succ.add(name)
+                    self._n_edges += 1
+                    self._edge_sites[(prev, name)] = site
+                    cycle = self._find_cycle_locked(name, prev)
+                    if cycle is not None:
+                        self._report_locked(cycle)
+            held.append(name)
+
+    def note_released(self, name: str, owner_tid: Optional[int] = None
+                      ) -> None:
+        """`owner_tid`: the thread that ACQUIRED the lock (the proxy
+        remembers it) — a Lock may legally be released by any thread,
+        and the stack to repair is the acquirer's."""
+        tid = owner_tid if owner_tid is not None else threading.get_ident()
+        with self._g:
+            held = self._stacks.get(tid)
+            if not held:
+                return
+            # Remove the most recent matching entry: release order need
+            # not be LIFO (that by itself is not a violation).
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+            if not held:
+                del self._stacks[tid]
+
+    def forget_later(self, name: str) -> None:
+        """GC hook: NO locking here — __del__ may run at any allocation
+        point, including while this thread already holds _g."""
+        self._dead.append(name)
+
+    def forget(self, name: str) -> None:
+        with self._g:
+            self._forget_locked(name)
+
+    def _forget_locked(self, name: str) -> None:
+        """Prune a garbage-collected lock's node (bounded growth for
+        per-connection / per-task locks in long-lived processes).
+        Already-reported violations keep their rendered strings."""
+        out = self._edges.pop(name, None)
+        if out:
+            self._n_edges -= len(out)
+            for b in out:
+                self._edge_sites.pop((name, b), None)
+        for a, succ in self._edges.items():
+            if name in succ:
+                succ.discard(name)
+                self._n_edges -= 1
+                self._edge_sites.pop((a, name), None)
 
     # -- cycle machinery (graph lock held) -------------------------------
 
@@ -129,6 +187,7 @@ class LockGraph:
                     set(self._edges) | {b for s in self._edges.values()
                                         for b in s}),
                 "edges": sum(len(s) for s in self._edges.values()),
+                "saturated": self.saturated,
                 "violations": list(self.violations),
             }
 
@@ -140,17 +199,30 @@ class _TracedLock:
         self._inner = _real_rlock() if rlock else _real_lock()
         self._graph = graph
         self._name = name
+        self._rlock = rlock
+        self._owner_tid: Optional[int] = None
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             site = _caller_site()
+            self._owner_tid = threading.get_ident()
             self._graph.note_acquired(self._name, site)
         return ok
 
     def release(self) -> None:
+        # For a plain Lock the releasing thread may differ from the
+        # acquirer (handoff pattern); the stack to repair is the
+        # ACQUIRER's.  RLocks are owner-released by definition.
+        owner = threading.get_ident() if self._rlock else self._owner_tid
         self._inner.release()
-        self._graph.note_released(self._name)
+        self._graph.note_released(self._name, owner)
+
+    def __del__(self):
+        try:
+            self._graph.forget_later(self._name)
+        except Exception:
+            pass
 
     def __enter__(self):
         self.acquire()
@@ -178,6 +250,10 @@ class _TracedLock:
             inner._acquire_restore(state)
         else:
             inner.acquire()
+        # Ownership moves to the woken waiter: a later release must
+        # repair THIS thread's stack, not the last plain-acquire()
+        # caller's.
+        self._owner_tid = threading.get_ident()
         self._graph.note_acquired(self._name, "condition-reacquire")
 
     def _release_save(self):
@@ -204,35 +280,43 @@ def _caller_site(depth: int = 2) -> str:
     return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
 
 
-_serial = [0]
+import itertools
+
+_serial = itertools.count(1)  # next() is atomic in CPython — two locks
+#                               born concurrently on one line must not
+#                               share a name (a shared name collapses
+#                               distinct instances into one node and
+#                               real inter-instance cycles read as
+#                               re-entry).
 
 
 def _name_from_site() -> str:
     """Name a lock by construction site + per-instance serial: the site
     makes violation reports self-describing, the serial keeps distinct
-    locks distinct nodes (two locks born on one line — e.g. striped or
-    comprehension-built — must not collapse into a single node, which
-    would both hide real inter-instance cycles and mislabel them as
-    re-entry)."""
+    locks distinct nodes."""
     f = sys._getframe(2)
     while f and f.f_globals.get("__name__") in (__name__, "threading"):
         f = f.f_back
-    _serial[0] += 1
+    n = next(_serial)
     if not f:
-        return f"anonymous#{_serial[0]}"
+        return f"anonymous#{n}"
     mod = f.f_globals.get("__name__", "?")
-    return f"{mod}:{f.f_lineno}#{_serial[0]}"
+    return f"{mod}:{f.f_lineno}#{n}"
 
 
 _active: Optional[LockGraph] = None
 
 
 def install() -> LockGraph:
-    """Swap threading.Lock/RLock for traced factories. Returns the graph."""
+    """Swap threading.Lock/RLock for traced factories bound to a FRESH
+    graph; returns it.  Installation nests: each install() stacks over
+    whatever was active (ambient YTPU_LOCKTRACE tracing included), and
+    uninstall() restores the previous layer — so a scoped `installed()`
+    block inside a traced process neither inherits stale edges nor
+    permanently disables the operator's process-wide tracing."""
     global _active
-    if _active is not None:
-        return _active
     graph = LockGraph()
+    graph._prev = (_active, threading.Lock, threading.RLock)
     _active = graph
 
     def make_lock():
@@ -247,10 +331,16 @@ def install() -> LockGraph:
 
 
 def uninstall() -> None:
+    """Pop the most recent install(), restoring the previous layer."""
     global _active
-    threading.Lock = _real_lock         # type: ignore[misc]
-    threading.RLock = _real_rlock       # type: ignore[misc]
-    _active = None
+    if _active is None:
+        threading.Lock = _real_lock     # type: ignore[misc]
+        threading.RLock = _real_rlock   # type: ignore[misc]
+        return
+    prev_active, prev_lock, prev_rlock = _active._prev
+    threading.Lock = prev_lock          # type: ignore[misc]
+    threading.RLock = prev_rlock        # type: ignore[misc]
+    _active = prev_active
 
 
 def active_graph() -> Optional[LockGraph]:
