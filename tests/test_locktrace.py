@@ -260,3 +260,23 @@ def test_rlock_reentry_with_intermediate_lock_is_clean():
                 with r:
                     pass
     assert g.violations == [], g.violations
+
+
+def test_lock_born_in_nested_window_reports_to_ambient_after_exit():
+    """Proxies resolve the reporting graph per event: a lock
+    constructed inside a scoped window must keep participating in the
+    ambient layer's tracing after the window closes."""
+    outer = locktrace.install()
+    try:
+        with locktrace.installed():
+            inner_born = threading.Lock()
+        mate = threading.Lock()
+        with inner_born:
+            with mate:
+                pass
+        with mate:
+            with inner_born:
+                pass
+        assert len(outer.violations) == 1, outer.violations
+    finally:
+        locktrace.uninstall()

@@ -550,3 +550,14 @@ def test_entry_probe_timeout_and_success_paths(monkeypatch):
     # greedy_cpu never probes at all.
     assert entry.ensure_policy_backend(
         "greedy_cpu", probe=lambda t: False) is False
+
+
+def test_policy_warmup_covers_all_selectable_policies():
+    """Every make_policy choice accepts warmup() before serving (the
+    entry calls it unconditionally); device policies compile without
+    touching real pool state."""
+    from yadcc_tpu.scheduler.entry import make_policy
+
+    for name in ("greedy_cpu", "jax_batched", "jax_grouped", "auto"):
+        p = make_policy(name, 64, avoid_self=True)
+        p.warmup(64)

@@ -35,6 +35,9 @@ echo "$(date -Is) capture loop starting (max ${MAX_MIN}m)" >> "$LOG"
 while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe >> "$LOG" 2>&1; then
     echo "$(date -Is) tunnel healthy; capturing" >> "$LOG"
+    # A stale bench_tpu.json from an earlier run must not satisfy the
+    # completion check below: every capture attempt starts fresh.
+    rm -f artifacts/bench_tpu.json
     # 1. Headline bench, TPU attempt only (no CPU fallback: a CPU
     #    number here would overwrite a useful artifact with noise).
     timeout "$BENCH_TIMEOUT" env BENCH_CHILD=1 python -u bench.py \

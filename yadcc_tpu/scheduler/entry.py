@@ -111,6 +111,10 @@ def scheduler_start(args) -> None:
 
     policy = make_policy(args.dispatch_policy, args.max_servants,
                          avoid_self=not args.allow_self_dispatch)
+    # Pre-compile the policy's device kernels for the serving shapes
+    # BEFORE accepting requests: a mid-serving jit compile would stall
+    # a live grant cycle for hundreds of ms.
+    policy.warmup(args.max_servants)
     dispatcher = TaskDispatcher(
         policy,
         max_servants=args.max_servants,

@@ -92,6 +92,11 @@ class DispatchPolicy:
                requests: Sequence[AssignRequest]) -> List[int]:
         raise NotImplementedError
 
+    def warmup(self, pool_size: int, env_words: int = 8) -> None:
+        """Pre-compile device kernels for the serving shapes (no-op for
+        host policies).  Entry points call this before serving so the
+        first real grant cycle never pays a jit compile."""
+
 
 class GreedyCpuPolicy(DispatchPolicy):
     """Faithful restatement of the reference's UnsafePickServantFor loop
@@ -138,6 +143,22 @@ class JaxBatchedPolicy(DispatchPolicy):
         self._max_batch = max_batch
         self._max_servants = max_servants
         self._pool_cache = _DevicePoolCache()
+
+    def warmup(self, pool_size: int, env_words: int = 8) -> None:
+        """One compile covers this policy: the batch pads to a single
+        fixed (pool_size, max_batch) shape.  Subclasses (pallas,
+        sharded) inherit via the _run_kernel/_prepare_pool hooks.
+        The all-zeros snapshot keeps epoch=-1, which bypasses the
+        device pool cache — warmup can never be mistaken for a real
+        pool."""
+        snap = PoolSnapshot(
+            alive=np.zeros(pool_size, bool),
+            capacity=np.zeros(pool_size, np.int32),
+            running=np.zeros(pool_size, np.int32),
+            dedicated=np.zeros(pool_size, bool),
+            version=np.zeros(pool_size, np.int32),
+            env_bitmap=np.zeros((pool_size, env_words), np.uint32))
+        self.assign(snap, [AssignRequest(0, 0, -1)])
 
     def assign(self, snap, requests):
         picks_all: List[int] = []
@@ -221,11 +242,46 @@ class JaxGroupedPolicy(DispatchPolicy):
         self._cm = cost_model
         self._max_groups = max_groups
         self._pool_cache = _DevicePoolCache()
+        self._warmed_pool_shapes: set = set()
 
     def _run_grouped_kernel(self, pool, batch):
         from ..ops import assignment_grouped as asg
 
         return asg.assign_grouped(pool, batch, self._cm)
+
+    def warmup(self, pool_size: int, env_words: int = 8) -> None:
+        """Compile every pad shape for this pool size up front.
+
+        The kernel recompiles per (pool size, padded group count); the
+        pad set {4, 8, ..., max_groups} is tiny but each first
+        occurrence would otherwise stall a LIVE grant cycle for the
+        compile (~hundreds of ms) the first time a batch with that many
+        runs shows up — possibly hours into serving.  The scheduler
+        entry calls this before accepting requests (the dispatcher's
+        pool arrays are fixed at max_servants, so one size covers the
+        process lifetime); deliberately NOT done lazily inside
+        assign(), where it would stall the very first grant cycles
+        instead.  All-zero-count warm batches grant nothing."""
+        import jax.numpy as jnp
+
+        from ..ops import assignment_grouped as asg
+
+        if (pool_size, env_words) in self._warmed_pool_shapes:
+            return
+        zeros = jnp.zeros(pool_size, jnp.int32)
+        pool = asn.PoolArrays(
+            alive=jnp.zeros(pool_size, bool),
+            capacity=zeros, running=zeros,
+            dedicated=jnp.zeros(pool_size, bool), version=zeros,
+            env_bitmap=jnp.zeros((pool_size, env_words), jnp.uint32))
+        pad = asg.group_pad(0)
+        while True:
+            self._run_grouped_kernel(
+                pool, asg.make_grouped_batch([], pad_to=pad))
+            if pad >= self._max_groups:
+                break
+            pad *= 2
+        self._warmed_pool_shapes.add((pool_size, env_words))
 
     def assign(self, snap, requests):
         from ..ops import assignment_grouped as asg
@@ -356,6 +412,9 @@ class AutoPolicy(DispatchPolicy):
         self._grouped = JaxGroupedPolicy(cost_model=cost_model)
         self._threshold = device_threshold  # None = pool-size adaptive
         self._device_dead = False
+
+    def warmup(self, pool_size: int, env_words: int = 8) -> None:
+        self._grouped.warmup(pool_size, env_words)
 
     def _use_greedy(self, snap, n: int) -> bool:
         if self._threshold is not None:

@@ -423,6 +423,7 @@ class TaskDispatcher:
         picks = self._policy.assign(snap, reqs)
 
         issued = 0
+        cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
         with self._lock:
             now = self._clock.now()
             for (req, is_prefetch), pick in zip(work, picks):
@@ -440,9 +441,26 @@ class TaskDispatcher:
                 # because other grants may have applied meanwhile.
                 if self._slot_generation[pick] != snap_generation[pick]:
                     continue
-                if len(servant.running_grants) >= self._effective_capacity_locked(
-                    servant
-                ):
+                # Capacity re-check, split into a per-cycle static part
+                # (gate flags + reported numbers, cached — ~512 grants
+                # per cycle often land on far fewer slots) and the
+                # running-count-dependent arithmetic which must track
+                # every grant applied in THIS loop.  Semantics identical
+                # to _effective_capacity_locked.
+                static = cap_cache.get(pick, False)
+                if static is False:
+                    info = servant.info
+                    static = cap_cache[pick] = (
+                        (info.capacity, info.num_processors,
+                         info.current_load)
+                        if info.not_accepting_reason == 0
+                        and info.memory_available >= self._min_memory
+                        else None)
+                if static is None:
+                    continue
+                cap, nprocs, load = static
+                n_running = len(servant.running_grants)
+                if n_running >= min(cap, nprocs - max(0, load - n_running)):
                     continue
                 g = _Grant(
                     grant_id=self._next_grant_id,

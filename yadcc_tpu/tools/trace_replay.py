@@ -148,11 +148,16 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
         snap = _snapshot_from_pool(events[0])
         # Untimed warmup: the jit policies pay one-time compilation on
         # their first call, which must not skew the A/B throughput.
-        # Distinct-descriptor counts cover every padded group shape the
-        # grouped policy may compile (8/16/32/64).  Policies only
-        # mutate their own running copy, so a fresh snapshot for the
-        # real run is all the isolation needed.
-        for n in (1, 12, 24, 48):
+        # warmup() covers every padded group-count shape for the pool
+        # size (the production path the scheduler entry uses); the
+        # assign probes additionally warm batch-shape-dependent
+        # policies (jax_batched pads on request count) — counts
+        # chosen so their run counts pad to 4/8/16/32/64.  Policies
+        # only mutate their own running copy, so a fresh snapshot for
+        # the real run is all the isolation needed.
+        policy.warmup(len(snap.alive),
+                      env_words=snap.env_bitmap.shape[1])
+        for n in (1, 6, 12, 24, 48):
             policy.assign(snap, [AssignRequest(e, 1, -1)
                                  for e in range(n)])
         snap = _snapshot_from_pool(events[0])

@@ -66,6 +66,8 @@ class LockGraph:
 
         self._g = _real_lock()  # guards the graph itself (never traced)
         self._edges: Dict[str, Set[str]] = {}
+        self._preds: Dict[str, Set[str]] = {}  # reverse index: O(degree)
+        #                                        pruning of GC'd nodes
         self._edge_sites: Dict[Tuple[str, str], str] = {}
         self.violations: List[str] = []
         self._reported: Set[Tuple[str, ...]] = set()
@@ -100,6 +102,7 @@ class LockGraph:
                         self.saturated = True
                         continue
                     succ.add(name)
+                    self._preds.setdefault(name, set()).add(prev)
                     self._n_edges += 1
                     self._edge_sites[(prev, name)] = site
                     cycle = self._find_cycle_locked(name, prev)
@@ -144,8 +147,12 @@ class LockGraph:
             self._n_edges -= len(out)
             for b in out:
                 self._edge_sites.pop((name, b), None)
-        for a, succ in self._edges.items():
-            if name in succ:
+                preds_b = self._preds.get(b)
+                if preds_b is not None:
+                    preds_b.discard(name)
+        for a in self._preds.pop(name, ()):
+            succ = self._edges.get(a)
+            if succ is not None and name in succ:
                 succ.discard(name)
                 self._n_edges -= 1
                 self._edge_sites.pop((a, name), None)
@@ -193,21 +200,33 @@ class LockGraph:
 
 
 class _TracedLock:
-    """Proxy satisfying the Lock/RLock duck type, reporting to a graph."""
+    """Proxy satisfying the Lock/RLock duck type.
 
-    def __init__(self, graph: LockGraph, name: str, rlock: bool):
+    The reporting graph is resolved PER EVENT from the active layer
+    (not captured at construction): a lock born inside a scoped
+    installed() window but outliving it must report to the ambient
+    layer afterwards, or its orderings silently vanish from the
+    operator's process-wide tracing.  Each acquisition remembers which
+    graph recorded it (LIFO per lock) so the matching release repairs
+    the right graph even across an install/uninstall boundary."""
+
+    def __init__(self, name: str, rlock: bool):
         self._inner = _real_rlock() if rlock else _real_lock()
-        self._graph = graph
         self._name = name
         self._rlock = rlock
         self._owner_tid: Optional[int] = None
+        self._graph_stack: List[Optional[LockGraph]] = []
+        self._seen: Set[LockGraph] = set()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
-            site = _caller_site()
+            g = _active
             self._owner_tid = threading.get_ident()
-            self._graph.note_acquired(self._name, site)
+            if g is not None:
+                g.note_acquired(self._name, _caller_site())
+                self._seen.add(g)
+            self._graph_stack.append(g)
         return ok
 
     def release(self) -> None:
@@ -216,11 +235,14 @@ class _TracedLock:
         # ACQUIRER's.  RLocks are owner-released by definition.
         owner = threading.get_ident() if self._rlock else self._owner_tid
         self._inner.release()
-        self._graph.note_released(self._name, owner)
+        g = self._graph_stack.pop() if self._graph_stack else None
+        if g is not None:
+            g.note_released(self._name, owner)
 
     def __del__(self):
         try:
-            self._graph.forget_later(self._name)
+            for g in self._seen:
+                g.forget_later(self._name)
         except Exception:
             pass
 
@@ -254,7 +276,11 @@ class _TracedLock:
         # repair THIS thread's stack, not the last plain-acquire()
         # caller's.
         self._owner_tid = threading.get_ident()
-        self._graph.note_acquired(self._name, "condition-reacquire")
+        g = _active
+        if g is not None:
+            g.note_acquired(self._name, "condition-reacquire")
+            self._seen.add(g)
+        self._graph_stack.append(g)
 
     def _release_save(self):
         inner = self._inner
@@ -263,7 +289,9 @@ class _TracedLock:
         else:
             inner.release()
             state = None
-        self._graph.note_released(self._name)
+        g = self._graph_stack.pop() if self._graph_stack else None
+        if g is not None:
+            g.note_released(self._name)
         return state
 
     def __repr__(self):
@@ -320,10 +348,10 @@ def install() -> LockGraph:
     _active = graph
 
     def make_lock():
-        return _TracedLock(graph, _name_from_site(), rlock=False)
+        return _TracedLock(_name_from_site(), rlock=False)
 
     def make_rlock():
-        return _TracedLock(graph, _name_from_site(), rlock=True)
+        return _TracedLock(_name_from_site(), rlock=True)
 
     threading.Lock = make_lock          # type: ignore[misc]
     threading.RLock = make_rlock        # type: ignore[misc]
