@@ -93,3 +93,22 @@ def test_preset_forced_cpu_honors_explicit_timeout(tmp_path):
     """, env_extra={"YTPU_FORCE_CPU": "1"}, timeout=30)
     assert r.returncode == 3
     assert time.monotonic() - t0 < 15
+
+
+def test_server_probe_skipped_when_cpu_preset(monkeypatch):
+    """YTPU_FORCE_CPU=1 on a server: no probe subprocess may run (it
+    would stall startup against the very tunnel being avoided)."""
+    from yadcc_tpu.utils import device_guard, exposed_vars
+
+    monkeypatch.setenv("YTPU_FORCE_CPU", "1")
+    ran = []
+    try:
+        forced = device_guard.ensure_backend_or_cpu(
+            expose_path="yadcc/test_platform",
+            probe=lambda t: ran.append(t) or True)
+        assert forced is True
+        assert ran == []
+        snap = exposed_vars.collect("yadcc/test_platform")
+        assert snap["yadcc"]["test_platform"]["reason"] == "YTPU_FORCE_CPU"
+    finally:
+        exposed_vars.unexpose("yadcc/test_platform")

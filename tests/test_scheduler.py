@@ -529,24 +529,25 @@ def test_entry_probe_failure_forces_cpu():
 
 
 def test_entry_probe_timeout_and_success_paths(monkeypatch):
-    """_probe_device_backend: TimeoutExpired -> False, healthy child ->
-    True — hermetic (no real jax subprocess: on the wedged hosts this
-    feature targets, a live probe would block the whole suite)."""
+    """probe_backend: TimeoutExpired -> False, healthy child -> True —
+    hermetic (no real jax subprocess: on the wedged hosts this feature
+    targets, a live probe would block the whole suite)."""
     import subprocess
 
     from yadcc_tpu.scheduler import entry
+    from yadcc_tpu.utils import device_guard
 
     def wedged(*a, **kw):
         raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
 
     monkeypatch.setattr(subprocess, "run", wedged)
-    assert entry._probe_device_backend(0.1) is False
+    assert device_guard.probe_backend(0.1) is False
 
     def healthy(*a, **kw):
         return subprocess.CompletedProcess(a, 0, stdout="ok\n", stderr="")
 
     monkeypatch.setattr(subprocess, "run", healthy)
-    assert entry._probe_device_backend(0.1) is True
+    assert device_guard.probe_backend(0.1) is True
     # greedy_cpu never probes at all.
     assert entry.ensure_policy_backend(
         "greedy_cpu", probe=lambda t: False) is False

@@ -56,9 +56,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def cache_server_start(args) -> None:
+    from ..utils.device_guard import ensure_backend_or_cpu
     from ..utils.locktrace import install_from_env
 
     install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
+    # The Bloom replica's device probes jit lazily; a wedged
+    # accelerator must degrade to CPU kernels, not hang a fetch.
+    ensure_backend_or_cpu(logger=logger,
+                          expose_path="yadcc/device_platform")
     if args.cache_engine == "disk":
         l2 = make_engine("disk", dirs=args.cache_dirs,
                          capacity=parse_size(args.l2_capacity))

@@ -87,9 +87,15 @@ def _guess_local_ip(scheduler_uri: str) -> str:
 
 
 def daemon_start(args) -> None:
+    from ..utils.device_guard import ensure_backend_or_cpu
     from ..utils.locktrace import install_from_env
 
     install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
+    # The delegate's Bloom batch probe jits lazily on the compile hot
+    # path; a wedged accelerator must degrade to CPU kernels, not hang
+    # the first cache lookup.
+    ensure_backend_or_cpu(logger=logger,
+                          expose_path="yadcc/device_platform")
     for var in _SCRUBBED_ENV:
         os.environ.pop(var, None)
     if not args.no_privilege_drop:

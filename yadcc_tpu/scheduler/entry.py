@@ -51,55 +51,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _probe_device_backend(timeout_s: float) -> bool:
-    """True iff a jax backend initializes AND runs one op in a fresh
-    subprocess within `timeout_s`.  Run before constructing any device
-    policy: a wedged accelerator tunnel hangs PJRT *inside* the first
-    jit call with no timeout, which in the scheduler would freeze the
-    dispatch thread and silently halt granting cluster-wide (observed
-    live: a wedged tunnel stalled the first policy compile forever
-    while heartbeats kept flowing).  A subprocess is the only safe
-    watchdog — a hung in-process jax call cannot be interrupted."""
-    import subprocess
-    import sys
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; "
-             "jnp.arange(4).sum().block_until_ready(); print('ok')"],
-            capture_output=True, text=True, timeout=timeout_s)
-        return r.returncode == 0 and "ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
-
-
-def ensure_policy_backend(policy_name: str,
-                          probe=_probe_device_backend) -> bool:
-    """Guard device policies against a wedged accelerator at startup.
-
+def ensure_policy_backend(policy_name: str, probe=None) -> bool:
+    """Guard device policies against a wedged accelerator at startup:
+    a wedged tunnel hangs PJRT inside the first policy compile, which
+    would freeze the dispatch thread and silently halt granting
+    cluster-wide (observed live) while heartbeats kept flowing.
     Returns True iff the CPU host platform was forced.  Policy math at
     pool sizes is correct and fast on host XLA; a frozen dispatch
     thread is neither."""
+    from ..utils.device_guard import ensure_backend_or_cpu, probe_backend
+
     if policy_name == "greedy_cpu":
         return False
-    import os
-
-    timeout_s = float(os.environ.get("YTPU_DEVICE_TIMEOUT", 120))
-    if probe(timeout_s):
-        return False
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    logger.warning(
-        "accelerator backend failed health probe (%ss); device "
-        "policies will compile on the CPU host platform — "
-        "granting stays live, relabel via /inspect", timeout_s)
-    exposed_vars.expose(
-        "yadcc/policy_platform",
-        lambda: {"forced_cpu": True,
-                 "reason": "device backend probe failed"})
-    return True
+    return ensure_backend_or_cpu(
+        logger=logger, expose_path="yadcc/policy_platform",
+        probe=probe if probe is not None else probe_backend)
 
 
 def scheduler_start(args) -> None:

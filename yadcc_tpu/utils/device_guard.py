@@ -44,6 +44,63 @@ def running_forced_cpu() -> bool:
     return bool(os.environ.get("YTPU_FORCE_CPU"))
 
 
+def probe_backend(timeout_s: float) -> bool:
+    """True iff a jax backend initializes AND runs one op in a fresh
+    subprocess within `timeout_s`.  A wedged accelerator tunnel hangs
+    PJRT *inside* the first jit call with no timeout; a subprocess is
+    the only safe watchdog — a hung in-process jax call cannot be
+    interrupted."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "jnp.arange(4).sum().block_until_ready(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def ensure_backend_or_cpu(logger=None, expose_path: str = "",
+                          probe=probe_backend) -> bool:
+    """Long-running servers that lazily jit device kernels (scheduler
+    policies, daemon/cache Bloom probes) call this at startup: if the
+    accelerator backend fails a watchdogged health probe, force the
+    CPU host platform in-process — a slower kernel beats a thread
+    frozen inside PJRT init holding a state machine hostage.  Returns
+    True iff CPU was forced; labels the downgrade via /inspect when
+    `expose_path` is given."""
+    if force_cpu_if_requested():
+        # Operator already ordered CPU (YTPU_FORCE_CPU=1, e.g. on a
+        # known-wedged host): skip the probe — it would stall startup
+        # for the full timeout against the very tunnel being avoided.
+        if expose_path:
+            from . import exposed_vars
+
+            exposed_vars.expose(
+                expose_path,
+                lambda: {"forced_cpu": True, "reason": "YTPU_FORCE_CPU"})
+        return True
+    timeout_s = float(os.environ.get("YTPU_DEVICE_TIMEOUT", 120))
+    if probe(timeout_s):
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if logger is not None:
+        logger.warning(
+            "accelerator backend failed health probe (%ss); device "
+            "kernels will compile on the CPU host platform", timeout_s)
+    if expose_path:
+        from . import exposed_vars
+
+        exposed_vars.expose(
+            expose_path,
+            lambda: {"forced_cpu": True,
+                     "reason": "device backend probe failed"})
+    return True
+
+
 def guard_device_entry(main, *, module: str = "",
                        timeout_env: str = "YTPU_DEVICE_TIMEOUT",
                        default_timeout_s: int = 600) -> None:
