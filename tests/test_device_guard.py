@@ -98,10 +98,13 @@ def test_preset_forced_cpu_honors_explicit_timeout(tmp_path):
 def test_server_probe_skipped_when_cpu_preset(monkeypatch):
     """YTPU_FORCE_CPU=1 on a server: no probe subprocess may run (it
     would stall startup against the very tunnel being avoided)."""
+    import jax
+
     from yadcc_tpu.utils import device_guard, exposed_vars
 
     monkeypatch.setenv("YTPU_FORCE_CPU", "1")
     ran = []
+    prior = jax.config.jax_platforms
     try:
         forced = device_guard.ensure_backend_or_cpu(
             expose_path="yadcc/test_platform",
@@ -112,3 +115,4 @@ def test_server_probe_skipped_when_cpu_preset(monkeypatch):
         assert snap["yadcc"]["test_platform"]["reason"] == "YTPU_FORCE_CPU"
     finally:
         exposed_vars.unexpose("yadcc/test_platform")
+        jax.config.update("jax_platforms", prior)

@@ -59,13 +59,12 @@ def ensure_policy_backend(policy_name: str, probe=None) -> bool:
     Returns True iff the CPU host platform was forced.  Policy math at
     pool sizes is correct and fast on host XLA; a frozen dispatch
     thread is neither."""
-    from ..utils.device_guard import ensure_backend_or_cpu, probe_backend
+    from ..utils.device_guard import ensure_backend_or_cpu
 
     if policy_name == "greedy_cpu":
         return False
     return ensure_backend_or_cpu(
-        logger=logger, expose_path="yadcc/policy_platform",
-        probe=probe if probe is not None else probe_backend)
+        logger=logger, expose_path="yadcc/policy_platform", probe=probe)
 
 
 def scheduler_start(args) -> None:

@@ -62,7 +62,7 @@ def probe_backend(timeout_s: float) -> bool:
 
 
 def ensure_backend_or_cpu(logger=None, expose_path: str = "",
-                          probe=probe_backend) -> bool:
+                          probe=None) -> bool:
     """Long-running servers that lazily jit device kernels (scheduler
     policies, daemon/cache Bloom probes) call this at startup: if the
     accelerator backend fails a watchdogged health probe, force the
@@ -82,7 +82,7 @@ def ensure_backend_or_cpu(logger=None, expose_path: str = "",
                 lambda: {"forced_cpu": True, "reason": "YTPU_FORCE_CPU"})
         return True
     timeout_s = float(os.environ.get("YTPU_DEVICE_TIMEOUT", 120))
-    if probe(timeout_s):
+    if (probe or probe_backend)(timeout_s):
         return False
     import jax
 
