@@ -437,6 +437,20 @@ struct Args {
       if (t == opt) return true;
     return false;
   }
+
+  // Like has(), but skips option VALUES (a token after -o/-MF/... is
+  // data, not a flag) — parity with the Python CompilerArgs.has(),
+  // which matches against parsed options only.
+  bool has_flag(const std::string &opt) const {
+    for (size_t i = 0; i < tail.size(); i++) {
+      if (takes_value(tail[i]) && i + 1 < tail.size()) {
+        i++;
+        continue;
+      }
+      if (tail[i] == opt) return true;
+    }
+    return false;
+  }
 };
 
 bool ends_with(const std::string &s, const char *suf) {
@@ -680,8 +694,24 @@ bool zstd_decompress(const std::string &in, std::string *out) {
   return ret == 0 || zin.pos == zin.size;
 }
 
-int compile_locally(const std::string &compiler, char **argv) {
-  bool got = acquire_quota(false);
+// Reference IsLightweightTask (yadcc-cxx.cc:68-81), mirrored by the
+// Python client's _is_lightweight_task: version probes and
+// preprocessing take the 1.5x-cores quota class so a configure stage
+// doesn't serialize behind real compiles.  Stdin sources opt in via
+// YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT.
+bool is_lightweight_task(const Args &a) {
+  if (a.has_flag("-dumpversion") || a.has_flag("-dumpmachine") ||
+      a.has_flag("-E"))
+    return true;
+  // A bare "-" in a non-value position is the stdin source; one in a
+  // value position (`-o -`, `-MF -`) is just data for that option and
+  // must not reclassify a real compile.
+  return env_int("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", 0) &&
+         a.has_flag("-");
+}
+
+int compile_locally(const std::string &compiler, const Args &a, char **argv) {
+  bool got = acquire_quota(is_lightweight_task(a));
   pid_t pid = fork();
   if (pid == 0) {
     std::vector<char *> args;
@@ -734,20 +764,20 @@ int main(int argc, char **argv) {
     // Same knob as the Python client: isolate whether a bad object
     // came from distribution or from the compiler itself.
     logf(30, "YTPU_DEBUGGING_COMPILE_LOCALLY=1: compiling locally");
-    return compile_locally(compiler, argv);
+    return compile_locally(compiler, args, argv);
   }
 
   const char *why = "";
   if (!is_distributable(args, &why)) {
     logf(10, "local (%s)", why);
-    return compile_locally(compiler, argv);
+    return compile_locally(compiler, args, argv);
   }
 
   // Preprocess under lightweight quota.
   bool quota = acquire_quota(true);
   if (!quota) {
     logf(30, "daemon unreachable; compiling locally");
-    return compile_locally(compiler, argv);
+    return compile_locally(compiler, args, argv);
   }
   Preprocessed pre;
   bool ok = run_preprocess(
@@ -760,10 +790,10 @@ int main(int argc, char **argv) {
                         &pre);
   }
   release_quota();
-  if (!ok) return compile_locally(compiler, argv);  // show real diagnostics
+  if (!ok) return compile_locally(compiler, args, argv);  // show real diagnostics
   if ((long)pre.raw_size <
       env_int("YTPU_COMPILE_ON_CLOUD_SIZE_THRESHOLD", 8192))
-    return compile_locally(compiler, argv);
+    return compile_locally(compiler, args, argv);
 
   int cache_control = env_int("YTPU_CACHE_CONTROL", 1);
   std::string inv = remote_invocation(args, pre.directives_only);
@@ -858,7 +888,7 @@ int main(int argc, char **argv) {
       std::string data;
       if (!zstd_decompress(chunks[i + 1], &data)) {
         logf(40, "corrupt output for %s", ext.c_str());
-        return compile_locally(compiler, argv);
+        return compile_locally(compiler, args, argv);
       }
       if (patches && patches->kind == Json::ARR) {
         for (const Json &pl : patches->arr) {
@@ -900,6 +930,6 @@ int main(int argc, char **argv) {
     return 0;
   }
   logf(30, "cloud failed repeatedly; falling back locally");
-  return compile_locally(compiler, argv);
+  return compile_locally(compiler, args, argv);
 }
 #endif  // YTPU_NO_MAIN

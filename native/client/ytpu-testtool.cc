@@ -14,6 +14,9 @@
 //      (-d sets directives_only, appending -fpreprocessed
 //       -fdirectives-only like the real pipeline)
 //   ytpu-testtool blake2b FILE            -> hex digest\0
+//   ytpu-testtool lightweight CC ARG...   -> "1" or "0"\0
+//      (quota class for a local run; must agree with the Python
+//       client's _is_lightweight_task)
 
 #define YTPU_NO_MAIN
 #include "ytpu-cxx.cc"
@@ -48,6 +51,13 @@ int main(int argc, char **argv) {
     std::string d = hex_digest_of_file(argv[2]);
     if (d.empty()) return 1;
     fwrite(d.data(), 1, d.size(), stdout);
+    fputc('\0', stdout);
+    return 0;
+  }
+  if (mode == "lightweight") {
+    if (argc < 3) return 2;
+    Args a = Args::parse(argc - 2, argv + 2);
+    fputs(is_lightweight_task(a) ? "1" : "0", stdout);
     fputc('\0', stdout);
     return 0;
   }

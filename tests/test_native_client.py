@@ -107,3 +107,59 @@ def test_blake2b_matches_hashlib(testtool, tmp_path):
         p.write_bytes(payload)
         (got,) = run_tool(testtool, "blake2b", str(p))
         assert got == digest_file(p), name
+
+
+QUOTA_CLASS_CASES = [
+    (["g++", "-dumpversion"], True),
+    (["g++", "-dumpmachine"], True),
+    (["g++", "-E", "x.cc"], True),
+    (["g++", "-O2", "-c", "x.cc"], False),
+    (["g++", "x.o", "-o", "a.out"], False),
+    # "-E" here is the VALUE of -MT, not a flag: still a heavy compile.
+    (["g++", "-c", "x.cc", "-MT", "-E"], False),
+]
+
+
+@pytest.mark.parametrize("argv,want", QUOTA_CLASS_CASES)
+def test_lightweight_quota_class_parity(testtool, argv, want,
+                                        monkeypatch):
+    """Version probes / -E take the lightweight quota class in BOTH
+    clients (reference IsLightweightTask, yadcc-cxx.cc:68-81); a
+    configure stage must not serialize behind real compiles."""
+    from yadcc_tpu.client.compiler_args import CompilerArgs
+    from yadcc_tpu.client.yadcc_cxx import _is_lightweight_task
+
+    monkeypatch.delenv("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT",
+                       raising=False)
+    assert _is_lightweight_task(CompilerArgs.parse(argv)) is want
+    assert run_tool(testtool, "lightweight", *argv) == \
+        ["1" if want else "0"]
+
+
+def run_tool_env(tool: Path, env: dict, *argv: str) -> list[str]:
+    import os
+
+    out = subprocess.run([str(tool), *argv], capture_output=True,
+                         check=True, env=dict(os.environ, **env)).stdout
+    assert out.endswith(b"\0")
+    return [p.decode() for p in out[:-1].split(b"\0")]
+
+
+def test_stdin_lightweight_env_knob(testtool, monkeypatch):
+    from yadcc_tpu.client.compiler_args import CompilerArgs
+    from yadcc_tpu.client.yadcc_cxx import _is_lightweight_task
+
+    argv = ["g++", "-c", "-x", "c++", "-", "-o", "probe.o"]
+    monkeypatch.delenv("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT",
+                       raising=False)
+    assert _is_lightweight_task(CompilerArgs.parse(argv)) is False
+    assert run_tool(testtool, "lightweight", *argv) == ["0"]
+    monkeypatch.setenv("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", "1")
+    assert _is_lightweight_task(CompilerArgs.parse(argv)) is True
+    knob = {"YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT": "1"}
+    assert run_tool_env(testtool, knob, "lightweight", *argv) == ["1"]
+    # A "-" that is an option VALUE must not reclassify a real compile
+    # even with the knob on.
+    heavy = ["g++", "-c", "x.cc", "-o", "-"]
+    assert _is_lightweight_task(CompilerArgs.parse(heavy)) is False
+    assert run_tool_env(testtool, knob, "lightweight", *heavy) == ["0"]

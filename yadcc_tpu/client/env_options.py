@@ -28,6 +28,10 @@ configuration is environment variables:
     YTPU_DEBUGGING_COMPILE_LOCALLY
                            1 = force every compile local (isolate
                            distribution from compiler bugs)
+    YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT
+                           1 = stdin-sourced compiles take lightweight
+                           quota (they're usually configure-time
+                           feature probes, not real TUs)
 """
 
 from __future__ import annotations
@@ -96,3 +100,7 @@ def debugging_compile_locally() -> bool:
     (reference YADCC_DEBUGGING_COMPILE_LOCALLY) — isolates whether a
     bad object came from distribution or from the compiler itself."""
     return _int_env("YTPU_DEBUGGING_COMPILE_LOCALLY", 0) == 1
+
+
+def treat_stdin_as_lightweight() -> bool:
+    return _int_env("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", 0) == 1

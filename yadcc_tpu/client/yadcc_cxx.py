@@ -32,7 +32,9 @@ from .compilation_saas import (
 from .compiler_args import CompilerArgs, is_distributable
 from .env_options import (cache_control, compile_on_cloud_size_threshold,
                           debugging_compile_locally,
-                          ignore_timestamp_macros, warn_on_noncacheable,
+                          ignore_timestamp_macros,
+                          treat_stdin_as_lightweight,
+                          warn_on_noncacheable,
                           warn_on_non_distributable)
 from .rewrite_file import rewrite_file
 from .task_quota import task_quota
@@ -67,8 +69,22 @@ def find_real_compiler(invoked_as: str) -> Optional[str]:
     return None
 
 
+def _is_lightweight_task(args: CompilerArgs) -> bool:
+    """Reference IsLightweightTask (yadcc-cxx.cc:68-81): version
+    probes and preprocessing barely load a core, so they take the
+    1.5x-cores quota class instead of the 0.5x heavy class — a
+    configure stage fires hundreds of these and must not serialize
+    behind real compiles.  Stdin sources opt in via env."""
+    if any(args.has(a) for a in ("-dumpversion", "-dumpmachine", "-E")):
+        return True
+    # has() matches parsed options only: a "-" that is the VALUE of
+    # -o/-MF is data, not the stdin source, and must not reclassify a
+    # real compile.
+    return treat_stdin_as_lightweight() and args.has("-")
+
+
 def _compile_locally(compiler: str, args: CompilerArgs) -> int:
-    with task_quota(lightweight=False):
+    with task_quota(lightweight=_is_lightweight_task(args)):
         return pass_through_to_program([compiler] + args.args)
 
 
