@@ -450,3 +450,30 @@ class TestHttpService:
             http_daemon, "/local/wait_for_cxx_task",
             b'{"task_id": "424242", "milliseconds_to_wait": 100}')
         assert code == 404
+
+
+def test_local_task_monitor_flag_overrides():
+    """--max-local-tasks / --lightweight-ratio override the derived
+    limits (reference --max_local_tasks /
+    --lightweight_local_task_overprovisioning_ratio)."""
+    from yadcc_tpu.daemon.local.local_task_monitor import LocalTaskMonitor
+
+    m = LocalTaskMonitor(nprocs=8, max_heavy_tasks=3, light_ratio=2.0)
+    snap = m.inspect()
+    assert snap["heavy_limit"] == 3
+    assert snap["light_limit"] == 16
+
+
+def test_debug_servant_override_redirects_every_dial():
+    """--debugging-always-use-servant-at (reference
+    distributed_task_dispatcher.cc:53-57): the granted location is
+    ignored at dial time; grants still flow normally."""
+    from yadcc_tpu.daemon.local.distributed_task_dispatcher import \
+        DistributedTaskDispatcher
+
+    d = DistributedTaskDispatcher(
+        grant_keeper=object(), config_keeper=object(),
+        debugging_always_use_servant_at="mock://debug-servant")
+    ch1 = d._channel("10.0.0.7:8335")
+    ch2 = d._channel("10.9.9.9:8335")
+    assert ch1 is ch2  # both dials collapsed onto the override

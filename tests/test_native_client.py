@@ -33,9 +33,12 @@ def testtool(native_build):
     return native_build / "ytpu-testtool"
 
 
-def run_tool(tool: Path, *argv: str) -> list[str]:
+def run_tool(tool: Path, *argv: str, env: dict | None = None) -> list[str]:
+    import os
+
     out = subprocess.run([str(tool), *argv], capture_output=True,
-                         check=True).stdout
+                         check=True,
+                         env=dict(os.environ, **env) if env else None).stdout
     assert out.endswith(b"\0")
     return [p.decode() for p in out[:-1].split(b"\0")]
 
@@ -136,15 +139,6 @@ def test_lightweight_quota_class_parity(testtool, argv, want,
         ["1" if want else "0"]
 
 
-def run_tool_env(tool: Path, env: dict, *argv: str) -> list[str]:
-    import os
-
-    out = subprocess.run([str(tool), *argv], capture_output=True,
-                         check=True, env=dict(os.environ, **env)).stdout
-    assert out.endswith(b"\0")
-    return [p.decode() for p in out[:-1].split(b"\0")]
-
-
 def test_stdin_lightweight_env_knob(testtool, monkeypatch):
     from yadcc_tpu.client.compiler_args import CompilerArgs
     from yadcc_tpu.client.yadcc_cxx import _is_lightweight_task
@@ -157,9 +151,9 @@ def test_stdin_lightweight_env_knob(testtool, monkeypatch):
     monkeypatch.setenv("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", "1")
     assert _is_lightweight_task(CompilerArgs.parse(argv)) is True
     knob = {"YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT": "1"}
-    assert run_tool_env(testtool, knob, "lightweight", *argv) == ["1"]
+    assert run_tool(testtool, "lightweight", *argv, env=knob) == ["1"]
     # A "-" that is an option VALUE must not reclassify a real compile
     # even with the knob on.
     heavy = ["g++", "-c", "x.cc", "-o", "-"]
     assert _is_lightweight_task(CompilerArgs.parse(heavy)) is False
-    assert run_tool_env(testtool, knob, "lightweight", *heavy) == ["0"]
+    assert run_tool(testtool, "lightweight", *heavy, env=knob) == ["0"]

@@ -88,12 +88,14 @@ class DiskCacheEngine(CacheEngine):
                 fp.write(f"{digest} {key}\n")
 
 
-def _make_disk(dirs: str = "", capacity: int = 32 << 30, **kw):
+def _make_disk(dirs: str = "", capacity: int = 32 << 30,
+               on_misplaced: str = DiskCache.ON_MISPLACED_MOVE, **kw):
     shard_dirs = [d for d in dirs.split(",") if d]
     if not shard_dirs:
         raise ValueError("disk engine requires --cache-dirs")
     per = capacity // len(shard_dirs)
-    return DiskCacheEngine([ShardSpec(d, per) for d in shard_dirs])
+    return DiskCacheEngine([ShardSpec(d, per) for d in shard_dirs],
+                           on_misplaced=on_misplaced)
 
 
 register_engine("disk", _make_disk)

@@ -49,6 +49,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--s3-secret-key", default="")
     p.add_argument("--s3-tls", action="store_true")
     p.add_argument("--l2-capacity", default="64G")
+    p.add_argument("--misplaced-entry-action", default="move",
+                   choices=["move", "delete", "ignore"],
+                   help="startup policy for disk entries found in the "
+                        "wrong shard after a topology change (reference "
+                        "--disk_engine_action_on_misplaced_cache_entry)")
     p.add_argument("--l1-capacity", default="4G")
     p.add_argument("--acceptable-user-tokens", default="")
     p.add_argument("--acceptable-servant-tokens", default="")
@@ -66,7 +71,8 @@ def cache_server_start(args) -> None:
                           expose_path="yadcc/device_platform")
     if args.cache_engine == "disk":
         l2 = make_engine("disk", dirs=args.cache_dirs,
-                         capacity=parse_size(args.l2_capacity))
+                         capacity=parse_size(args.l2_capacity),
+                         on_misplaced=args.misplaced_entry_action)
     elif args.cache_engine == "objstore":
         l2 = make_engine("objstore", root=args.cache_dirs,
                          capacity=parse_size(args.l2_capacity))

@@ -276,7 +276,8 @@ class DaemonService:
             version=VERSION_FOR_UPGRADE,
             location=self.config.location,
             num_processors=self.sampler.nprocs,
-            current_load=self.sampler.loadavg(15),
+            current_load=self.sampler.loadavg(
+                self.config.cpu_load_average_seconds),
             priority=(api.scheduler.SERVANT_PRIORITY_DEDICATED if dedicated
                       else api.scheduler.SERVANT_PRIORITY_USER),
             not_accepting_task_reason=reason,
@@ -313,5 +314,7 @@ class DaemonService:
         return {
             "engine": self.engine.inspect(),
             "compilers": self.registry.environments(),
-            "load_15s": self.sampler.loadavg(15),
+            "load": self.sampler.loadavg(
+                self.config.cpu_load_average_seconds),
+            "load_window_s": self.config.cpu_load_average_seconds,
         }

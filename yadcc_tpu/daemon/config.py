@@ -27,6 +27,18 @@ class DaemonConfig:
 
     # Delegate side.
     local_port: int = 8334
+    # 0 = derive heavy limit from cores (reference --max_local_tasks).
+    max_local_tasks: int = 0
+    # Reference --lightweight_local_task_overprovisioning_ratio.
+    lightweight_overprovisioning_ratio: float = 1.5
+    # Reference --debugging_always_use_servant_at: dial THIS servant
+    # for every dispatched task instead of the granted one (grants
+    # still come from the scheduler).  Debug/testing only.
+    debugging_always_use_servant_at: str = ""
+
+    # Reference --cpu_load_average_seconds / --compiler_rescan_interval.
+    cpu_load_average_seconds: int = 15
+    compiler_rescan_interval: float = 60.0
 
     temporary_dir: str = field(default_factory=default_temp_root)
     inspect_port: int = 9335

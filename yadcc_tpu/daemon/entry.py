@@ -70,6 +70,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="serve even inside a cgroup/container; only safe "
                         "when the container really owns its cores")
     p.add_argument("--no-privilege-drop", action="store_true")
+    p.add_argument("--max-local-tasks", type=int, default=0,
+                   help="heavy-class local quota; 0 = cores/2 "
+                        "(reference --max_local_tasks)")
+    p.add_argument("--lightweight-ratio", type=float, default=1.5,
+                   help="lightweight-class quota as a multiple of cores "
+                        "(reference "
+                        "--lightweight_local_task_overprovisioning_ratio)")
+    def _load_window(v: str) -> int:
+        n = int(v)
+        if not 1 <= n <= 60:
+            # The sampler ring holds 61 one-second samples; outside
+            # this range the math silently degrades (0 reports a
+            # permanently idle machine and the scheduler over-grants).
+            raise argparse.ArgumentTypeError(
+                "--cpu-load-average-seconds must be in 1..60")
+        return n
+
+    p.add_argument("--cpu-load-average-seconds", type=_load_window,
+                   default=15, help="loadavg window reported in "
+                                    "heartbeats (1..60)")
+    p.add_argument("--compiler-rescan-interval", type=float, default=60.0)
+    p.add_argument("--debugging-always-use-servant-at", default="",
+                   help="debug only: dial THIS servant for every "
+                        "dispatched task instead of the granted one")
     return p
 
 
@@ -109,6 +133,11 @@ def daemon_start(args) -> None:
         local_port=args.local_port,
         servant_priority_dedicated=args.dedicated,
         max_remote_tasks=args.max_remote_tasks,
+        max_local_tasks=args.max_local_tasks,
+        lightweight_overprovisioning_ratio=args.lightweight_ratio,
+        debugging_always_use_servant_at=args.debugging_always_use_servant_at,
+        cpu_load_average_seconds=args.cpu_load_average_seconds,
+        compiler_rescan_interval=args.compiler_rescan_interval,
     )
     if args.temporary_dir:
         config.temporary_dir = args.temporary_dir
@@ -150,8 +179,11 @@ def daemon_start(args) -> None:
         config_keeper=config_keeper,
         cache_reader=cache_reader,
         running_task_keeper=running_keeper,
+        debugging_always_use_servant_at=config.debugging_always_use_servant_at,
     )
-    monitor = LocalTaskMonitor()
+    monitor = LocalTaskMonitor(
+        max_heavy_tasks=config.max_local_tasks,
+        light_ratio=config.lightweight_overprovisioning_ratio)
     digest_cache = FileDigestCache()
     stop = threading.Event()
     http = LocalHttpService(
@@ -180,7 +212,7 @@ def daemon_start(args) -> None:
         time.sleep(1.0)
         dispatcher.on_timer()
         monitor.on_reclaim_timer()
-        if time.monotonic() - last_rescan >= 60.0:
+        if time.monotonic() - last_rescan >= config.compiler_rescan_interval:
             registry.rescan()
             last_rescan = time.monotonic()
 

@@ -70,12 +70,16 @@ class DistributedTaskDispatcher:
         cache_reader: Optional[DistributedCacheReader] = None,
         running_task_keeper: Optional[RunningTaskKeeper] = None,
         pid_prober=None,
+        debugging_always_use_servant_at: str = "",
     ):
         self._grants = grant_keeper
         self._config = config_keeper
         self._cache = cache_reader
         self._running = running_task_keeper
         self._pid_alive = pid_prober or _default_pid_alive
+        # Debug override (reference --debugging_always_use_servant_at):
+        # every servant dial goes HERE; grants still flow normally.
+        self._debug_servant = debugging_always_use_servant_at
         self._lock = threading.Lock()
         self._tasks: Dict[int, _Entry] = {}
         self._next_id = 1
@@ -289,6 +293,8 @@ class DistributedTaskDispatcher:
     # -- plumbing ------------------------------------------------------------
 
     def _channel(self, location: str) -> Channel:
+        if self._debug_servant:
+            location = self._debug_servant
         with self._lock:
             ch = self._channels.get(location)
             if ch is None:

@@ -34,10 +34,15 @@ def _pid_alive(pid: int) -> bool:
 
 class LocalTaskMonitor:
     def __init__(self, nprocs: int = 0,
-                 pid_prober=_pid_alive):
+                 pid_prober=_pid_alive,
+                 max_heavy_tasks: int = 0,
+                 light_ratio: float = _LIGHT_RATIO):
         n = nprocs or os.cpu_count() or 1
-        self._light_limit = max(1, int(n * _LIGHT_RATIO))
-        self._heavy_limit = max(1, int(n * _HEAVY_RATIO))
+        self._light_limit = max(1, int(n * light_ratio))
+        # The >=1 floor applies to the override too: a non-positive
+        # --max-local-tasks must not block every heavy compile forever.
+        self._heavy_limit = max(1, max_heavy_tasks) if max_heavy_tasks \
+            else max(1, int(n * _HEAVY_RATIO))
         self._pid_alive = pid_prober
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)

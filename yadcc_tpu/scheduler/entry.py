@@ -44,6 +44,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--acceptable-servant-tokens", default="")
     p.add_argument("--servant-min-memory-for-new-task",
                    default="10G")
+    p.add_argument("--token-rollout-interval", type=float, default=3600.0,
+                   help="serving-daemon token rotation period, seconds "
+                        "(reference --serving_daemon_token_rollout_interval)")
     p.add_argument("--allow-self-dispatch", action="store_true",
                    help="let a machine compile its own submissions via "
                         "the network path (single-machine rigs/tests; "
@@ -93,6 +96,7 @@ def scheduler_start(args) -> None:
         servant_tokens=make_token_verifier_from_flag(
             args.acceptable_servant_tokens),
         min_daemon_version=args.min_daemon_version,
+        token_rotation_s=args.token_rollout_interval,
     )
     exposed_vars.expose("yadcc/task_dispatcher", dispatcher.inspect)
 
