@@ -322,3 +322,62 @@ class TestShardedAssign2D:
             flips = rng.random(s) < 0.02
             pool_np["alive"] = pool_np["alive"] ^ flips
             pool_np["running"][flips & ~pool_np["alive"]] = 0
+
+
+class TestShardedGroupedAssign:
+    """Pod-scale grouped kernel (parallel/mesh.py
+    sharded_assign_grouped_fn): the flagship threshold-search policy
+    with the servant axis sharded — one scalar psum per bisect step —
+    must match the single-device grouped kernel bit for bit, including
+    the cross-device lowest-slot tie split."""
+
+    def _random_groups(self, rng, s, n=4):
+        return [(int(rng.integers(0, 256)), 1,
+                 int(rng.integers(-1, s)),
+                 int(rng.integers(1, 300))) for _ in range(n)]
+
+    def test_s8192_churn_parity(self):
+        from yadcc_tpu.ops import assignment_grouped as asg
+
+        mesh = pmesh.make_mesh(8)
+        rng = np.random.default_rng(77)
+        s, steps = 8192, 4
+        pool_np = random_pool_np(rng, s)
+        fn = pmesh.sharded_assign_grouped_fn(mesh)
+
+        for step in range(steps):
+            batch = asg.make_grouped_batch(
+                self._random_groups(rng, s), pad_to=4)
+            pool = to_pool_arrays(pool_np)
+            want_c, want_r = asg.assign_grouped(pool, batch)
+            got_c, got_r = fn(pmesh.shard_pool(pool, mesh), batch)
+            assert np.array_equal(np.asarray(got_c),
+                                  np.asarray(want_c)), f"step {step}"
+            assert np.array_equal(np.asarray(got_r),
+                                  np.asarray(want_r)), f"step {step}"
+
+            pool_np["running"] = np.array(want_r)
+            flips = rng.random(s) < 0.02
+            pool_np["alive"] = pool_np["alive"] ^ flips
+            died = flips & ~pool_np["alive"]
+            pool_np["running"][died] = 0
+        assert pool_np["alive"].sum() not in (0, s)
+
+    def test_2d_mesh_matches_and_exhausts_pool(self):
+        """(hosts x chips) mesh; an over-subscribed group (m > total
+        feasible) must cap at the pool's capacity on both paths."""
+        from yadcc_tpu.ops import assignment_grouped as asg
+
+        mesh = pmesh.make_mesh_2d(2, 4)
+        rng = np.random.default_rng(78)
+        s = 512
+        pool_np = random_pool_np(rng, s)
+        pool = to_pool_arrays(pool_np)
+        batch = asg.make_grouped_batch(
+            [(3, 1, -1, 10_000)], pad_to=4)  # far beyond capacity
+        want_c, want_r = asg.assign_grouped(pool, batch)
+        fn = pmesh.sharded_assign_grouped_fn(mesh)
+        got_c, got_r = fn(pmesh.shard_pool_2d(pool, mesh), batch)
+        assert np.array_equal(np.asarray(got_c), np.asarray(want_c))
+        assert np.array_equal(np.asarray(got_r), np.asarray(want_r))
+        assert int(np.asarray(got_c).sum()) > 0

@@ -56,17 +56,22 @@ class GroupedBatch(NamedTuple):
     count: jax.Array       # int32[G] — identical requests in the group
 
 
-def _group_counts(
+def make_count_leq(
     pool: PoolArrays,
     running: jax.Array,
     env_id: jax.Array,
     min_version: jax.Array,
     requestor: jax.Array,
-    m: jax.Array,
     cm: DispatchCostModel,
-) -> jax.Array:
-    """int32[S]: grants per servant for one group of m identical
-    requests, matching sequential greedy exactly."""
+):
+    """Build the per-servant `count_leq(tau)` closure for one group.
+
+    Shared by the local kernel below and the sharded pod-scale variant
+    (parallel/mesh.py sharded_assign_grouped_fn), which runs it on each
+    device's pool slice and reduces totals with psum — the arithmetic
+    must be ONE definition or the two diverge.  `requestor` is a slot
+    index in THIS pool's numbering (the sharded caller translates the
+    global slot to local or -1)."""
     s = pool.alive.shape[0]
     slots = jnp.arange(s, dtype=jnp.int32)
 
@@ -118,8 +123,30 @@ def _group_counts(
         ded = jnp.minimum(pref_cap, pref_total) + plain_above
         return jnp.where(pool.dedicated, ded, plain)
 
-    lo = -bonus_q - 1           # below every possible score
-    hi = jnp.int32(UTIL_SCALE + 1)  # above every feasible score
+    return count_leq
+
+
+# Bisect bounds over the integer score domain.
+def search_bounds(cm: DispatchCostModel):
+    bonus_q = jnp.int32(cm.preference_bonus_q)
+    return (-bonus_q - 1,              # below every possible score
+            jnp.int32(UTIL_SCALE + 1))  # above every feasible score
+
+
+def _group_counts(
+    pool: PoolArrays,
+    running: jax.Array,
+    env_id: jax.Array,
+    min_version: jax.Array,
+    requestor: jax.Array,
+    m: jax.Array,
+    cm: DispatchCostModel,
+) -> jax.Array:
+    """int32[S]: grants per servant for one group of m identical
+    requests, matching sequential greedy exactly."""
+    count_leq = make_count_leq(pool, running, env_id, min_version,
+                               requestor, cm)
+    lo, hi = search_bounds(cm)
 
     def bisect(state, _):
         lo, hi = state

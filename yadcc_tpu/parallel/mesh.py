@@ -67,6 +67,27 @@ def make_mesh_2d(n_hosts: int, chips_per_host: int) -> Mesh:
                 "real host topology (use jax.local_device_count())")
     return Mesh(grid, (HOST_AXIS, WORKER_AXIS))
 
+def device_linear_index(mesh: Mesh, axes) -> jax.Array:
+    """Row-major linear device index over `axes` — THE global slot
+    numbering convention: a device's pool slice of size s_local covers
+    global slots [linear*s_local, (linear+1)*s_local).  Both sharded
+    kernels derive their cross-device lowest-slot tie-breaks from this
+    one definition (trace inside a shard_map body only)."""
+    linear = jnp.int32(0)
+    for name in axes:
+        linear = linear * mesh.shape[name] + jax.lax.axis_index(name)
+    return linear
+
+
+def pool_partition_spec(axes) -> PoolArrays:
+    """PartitionSpecs for a PoolArrays pytree with the servant axis
+    sharded over `axes` (shard_map in_specs form)."""
+    return PoolArrays(
+        alive=P(axes), capacity=P(axes), running=P(axes),
+        dedicated=P(axes), version=P(axes), env_bitmap=P(axes, None),
+    )
+
+
 def pool_sharding(mesh: Mesh) -> PoolArrays:
     """NamedShardings for a PoolArrays pytree: the servant axis shards
     over EVERY mesh axis (row-major), so one helper serves the 1-level
@@ -111,10 +132,7 @@ def sharded_assign_fn(mesh: Mesh,
 
     def body(pool: PoolArrays, batch: TaskBatch):
         s_local = pool.alive.shape[0]
-        # Linear device index, row-major over the mesh axes.
-        linear = jnp.int32(0)
-        for name in axes:
-            linear = linear * mesh.shape[name] + jax.lax.axis_index(name)
+        linear = device_linear_index(mesh, axes)
         base = linear * s_local  # global slot of local row 0
 
         def step(running, task):
@@ -149,10 +167,7 @@ def sharded_assign_fn(mesh: Mesh,
         )
         return picks, running
 
-    pool_spec = PoolArrays(
-        alive=P(axes), capacity=P(axes), running=P(axes),
-        dedicated=P(axes), version=P(axes), env_bitmap=P(axes, None),
-    )
+    pool_spec = pool_partition_spec(axes)
     batch_spec = TaskBatch(env_id=P(), min_version=P(), requestor=P(),
                            valid=P())
     fn = shard_map(
@@ -168,6 +183,92 @@ def sharded_assign_fn(mesh: Mesh,
 # The 2-level entry point is the same implementation: the hierarchical
 # reduction above is driven by the mesh's axis list.
 sharded_assign_fn_2d = sharded_assign_fn
+
+
+def sharded_assign_grouped_fn(
+        mesh: Mesh, cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
+    """Pod-scale variant of the flagship grouped kernel
+    (ops/assignment_grouped.py): the servant axis sharded over ALL mesh
+    axes, one (grant_counts [G, S], running [S]) result, outcomes
+    bit-identical to the single-device kernel.
+
+    Collective cost per group is tiny and pool-size-independent: the
+    threshold bisect needs one scalar psum per iteration (~22), plus
+    two for the tie split — each device computes count_leq over its
+    slice only.  The cross-device tie-break reuses the oracle's
+    lowest-slot rule: devices split the `need_at` tau-ties in linear
+    device order via an exclusive prefix of per-device tie counts
+    (computed with one psum of a device-indexed one-hot, no gather
+    ordering assumptions)."""
+    from ..ops.assignment_grouped import (_SEARCH_ITERS, GroupedBatch,
+                                          make_count_leq, search_bounds)
+
+    axes = tuple(mesh.axis_names)
+    cm = cost_model
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def body(pool: PoolArrays, batch: GroupedBatch):
+        s_local = pool.alive.shape[0]
+        linear = device_linear_index(mesh, axes)
+        base = linear * s_local
+
+        def group_step(running, group):
+            env_id, min_version, requestor, m = group
+            local_req = jnp.where(
+                (requestor >= base) & (requestor < base + s_local),
+                requestor - base, jnp.int32(-1))
+            count_leq = make_count_leq(pool, running, env_id,
+                                       min_version, local_req, cm)
+            lo, hi = search_bounds(cm)
+
+            def bisect(state, _):
+                lo, hi = state
+                mid = (lo + hi) // 2
+                total = jax.lax.psum(count_leq(mid).sum(), axes)
+                lo = jnp.where(total >= m, lo, mid)
+                hi = jnp.where(total >= m, mid, hi)
+                return (lo, hi), None
+
+            (lo, hi), _ = jax.lax.scan(
+                bisect, (jnp.int32(lo), hi), None,
+                length=_SEARCH_ITERS)
+            tau = hi
+
+            below = count_leq(tau - 1)
+            at = count_leq(tau) - below
+            need_at = m - jax.lax.psum(below.sum(), axes)
+            # Exclusive prefix of per-device tie counts in linear
+            # device order: scatter my total into a device-indexed
+            # vector, psum it, then sum entries before mine.
+            at_total = at.sum()
+            vec = jnp.zeros(n_dev, jnp.int32).at[linear].set(at_total)
+            vec = jax.lax.psum(vec, axes)
+            dev_prefix = jnp.where(jnp.arange(n_dev) < linear,
+                                   vec, 0).sum()
+            cum_before = dev_prefix + jnp.cumsum(at) - at
+            take_at = jnp.clip(need_at - cum_before, 0, at)
+            counts = (below + take_at).astype(jnp.int32)
+            return running + counts, counts
+
+        running, counts = jax.lax.scan(
+            group_step,
+            pool.running,
+            (batch.env_id, batch.min_version, batch.requestor,
+             batch.count),
+        )
+        return counts, running
+
+    pool_spec = pool_partition_spec(axes)
+    batch_spec = GroupedBatch(env_id=P(), min_version=P(),
+                              requestor=P(), count=P())
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pool_spec, batch_spec),
+        out_specs=(P(None, axes), P(axes)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def sharded_bloom_probe_fn(mesh: Mesh, *, num_bits: int, num_hashes: int):

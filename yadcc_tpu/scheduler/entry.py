@@ -34,7 +34,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--dispatch-policy", default="auto",
                    choices=["auto", "greedy_cpu", "jax_batched",
                             "jax_grouped", "jax_pallas",
-                            "jax_pallas_grouped", "jax_sharded"],
+                            "jax_pallas_grouped", "jax_sharded",
+                            "jax_sharded_grouped"],
                    help="auto = host greedy under 16 waiters, grouped "
                         "device kernel above (the measured winner, "
                         "artifacts/trace_ab.json)")

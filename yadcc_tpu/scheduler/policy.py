@@ -249,6 +249,12 @@ class JaxGroupedPolicy(DispatchPolicy):
 
         return asg.assign_grouped(pool, batch, self._cm)
 
+    def _prepare_grouped_pool(self, snap, running):
+        """Hook: how the snapshot becomes device arrays.  The sharded
+        subclass distributes the pool over its mesh here instead of
+        letting jit reshard a device-0 upload every cycle."""
+        return _upload_pool(snap, running, self._pool_cache)
+
     def warmup(self, pool_size: int, env_words: int = 8) -> None:
         """Compile every pad shape for this pool size up front.
 
@@ -303,7 +309,7 @@ class JaxGroupedPolicy(DispatchPolicy):
                 [(k[0], k[1], k[2], len(m)) for k, m in chunk],
                 pad_to=pad)
             counts, new_running = self._run_grouped_kernel(
-                _upload_pool(snap, running, self._pool_cache), batch)
+                self._prepare_grouped_pool(snap, running), batch)
             counts = np.asarray(counts)
             running = np.asarray(new_running)
             # Expand (group, slot)->count into per-request picks with
@@ -349,6 +355,40 @@ class JaxShardedPolicy(JaxBatchedPolicy):
         return self._shard(_upload_pool(snap, running), self._mesh)
 
     def _run_kernel(self, pool, batch):
+        return self._fn(pool, batch)
+
+
+class JaxShardedGroupedPolicy(JaxGroupedPolicy):
+    """The flagship grouped threshold search with the servant axis
+    sharded over ALL attached devices (parallel/mesh.py
+    sharded_assign_grouped_fn): ~22 scalar psums per group regardless
+    of pool size.  On one device it degenerates to the plain kernel
+    (shard_map overhead only); on a pod slice the registry splits
+    across chips — the deployment shape for pools past one chip.
+    Bit-identical outcomes: tests/test_assignment.py
+    TestShardedGroupedAssign."""
+
+    name = "jax_sharded_grouped"
+
+    def __init__(self, max_groups: int = 64,
+                 cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
+        super().__init__(max_groups, cost_model)
+        from ..parallel import mesh as pmesh
+
+        self._mesh = pmesh.make_mesh()
+        self._fn = pmesh.sharded_assign_grouped_fn(self._mesh, cost_model)
+        self._shard = pmesh.shard_pool
+        self._ndev = int(self._mesh.devices.size)
+
+    def _prepare_grouped_pool(self, snap, running):
+        s = snap.alive.shape[0]
+        if s % self._ndev:
+            raise ValueError(
+                f"pool size {s} must divide evenly over "
+                f"{self._ndev} devices (pad max_servants)")
+        return self._shard(_upload_pool(snap, running), self._mesh)
+
+    def _run_grouped_kernel(self, pool, batch):
         return self._fn(pool, batch)
 
 
@@ -454,6 +494,8 @@ def make_policy(name: str, max_servants: int,
         return JaxShardedPolicy(max_servants, cost_model=cm)
     if name == "jax_pallas_grouped":
         return JaxPallasGroupedPolicy(cost_model=cm)
+    if name == "jax_sharded_grouped":
+        return JaxShardedGroupedPolicy(cost_model=cm)
     if name == "auto":
         return AutoPolicy(cost_model=cm)
     raise ValueError(f"unknown dispatch policy {name!r}")

@@ -134,6 +134,11 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
             pass
         import jax
 
+        from ..scheduler.policy import JaxShardedGroupedPolicy
+
+        if s % max(1, len(jax.devices())) == 0:
+            policies["jax_sharded_grouped"] = JaxShardedGroupedPolicy()
+
         if jax.devices()[0].platform == "tpu":
             # Native-compiled Pallas variants join the panel on real
             # hardware (the interpreter would be minutes-slow on CPU;
