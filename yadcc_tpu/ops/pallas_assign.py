@@ -52,6 +52,7 @@ def _kernel_body(cm: DispatchCostModel):
         @pl.when(t == 0)
         def _():
             running_scratch[:] = running_in_ref[:]
+            picks_ref[:] = jnp.full_like(picks_ref, NO_PICK)
 
         running = running_scratch[:]
         s = running.shape[0]
@@ -83,13 +84,21 @@ def _kernel_body(cm: DispatchCostModel):
         # Mosaic-friendly forms only: the score at the argmin IS the
         # min (no dynamic scalar gather), the capacity decrement is a
         # one-hot vector add (no dynamic scalar scatter), and the pick
-        # lands in a per-step (1,)-block of the output (no dynamic
-        # store) — dynamic scalar indexing into VMEM is exactly the
-        # class of op that works interpreted but fails TPU lowering.
-        pick = jnp.argmin(score).astype(jnp.int32)
-        granted = (jnp.min(score) < cm.infeasible_score_q) & (
-            valid_ref[t] != 0)
-        picks_ref[0] = jnp.where(granted, pick, NO_PICK)
+        # lands in the full-array picks block via an iota select — both
+        # dynamic scalar VMEM stores AND sub-tile (1,)-element output
+        # blocks (rank-1 blocks must be 128-multiples or the full dim
+        # on real hardware) are the class of construct that works
+        # interpreted but fails TPU lowering.
+        # argmin has no int32 Mosaic lowering ("Only float32 is
+        # supported"); min+where is equivalent AND spells out the
+        # lowest-slot tie-break the contract requires.
+        best = jnp.min(score)
+        pick = jnp.min(jnp.where(score == best, slots, s)).astype(
+            jnp.int32)
+        granted = (best < cm.infeasible_score_q) & (valid_ref[t] != 0)
+        tasks = jax.lax.broadcasted_iota(jnp.int32, picks_ref.shape, 0)
+        picks_ref[:] = jnp.where(
+            tasks == t, jnp.where(granted, pick, NO_PICK), picks_ref[:])
         running_scratch[:] = running + jnp.where(
             (slots == pick) & granted, 1, 0).astype(jnp.int32)
 
@@ -128,9 +137,10 @@ def pallas_assign_batch(
             pl.BlockSpec(memory_space=pltpu.VMEM),  # env_bitmap
         ],
         out_specs=[
-            # One (1,)-element block per grid step: the kernel writes
-            # picks_ref[0], never a dynamically-indexed position.
-            pl.BlockSpec((1,), lambda i, *_: (i,),
+            # Full (t,)-array block revisited every step: Mosaic rejects
+            # (1,)-element rank-1 blocks (must be a 128-multiple or the
+            # whole dim); each step lands its pick by iota select.
+            pl.BlockSpec((t,), lambda i, *_: (0,),
                          memory_space=pltpu.VMEM),  # picks
             pl.BlockSpec((s,), lambda i, *_: (0,),
                          memory_space=pltpu.VMEM),  # running_out

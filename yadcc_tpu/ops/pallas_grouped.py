@@ -15,11 +15,13 @@ grouped batch in one `pl.pallas_call`:
   on VMEM-resident data — no HBM traffic between iterations.
 
 Mosaic-safe construction only (the lessons of pallas_assign.py): no
-dynamic scalar indexing into VMEM, per-group (1, S) output blocks,
-transposed env bitmap so the dynamic word index lands on the sublane
-axis.  Math is IDENTICAL to assignment_grouped._group_counts — the
-golden tests cross-check all three implementations (oracle, XLA,
-Pallas) on the same pools.
+dynamic scalar indexing into VMEM, a full-array counts block revisited
+every step (sub-tile (1, S) row blocks fail the (8, 128) tiling rule on
+real hardware) with rows landed by iota select, transposed env bitmap
+so the dynamic word index lands on the sublane axis.  Math is
+IDENTICAL to assignment_grouped._group_counts — the golden tests
+cross-check all three implementations (oracle, XLA, Pallas) on the
+same pools.
 """
 
 from __future__ import annotations
@@ -38,6 +40,37 @@ from .assignment import PoolArrays
 from .assignment_grouped import _SEARCH_ITERS, GroupedBatch
 
 
+def _take_lowest_slots(at: jax.Array, need: jax.Array,
+                       slots: jax.Array) -> jax.Array:
+    """Split `need` tie-grants across servants, lowest slot first.
+
+    Equivalent to `clip(need - (cumsum(at) - at), 0, at)` — but neither
+    jnp.cumsum nor pltpu.roll lowers for 1-D vectors on real hardware
+    (Mosaic: "Unimplemented: cumsum" / "Unsupported 1D shape"), so the
+    cut slot is found by one more binary search over the slot domain
+    using only where/sum, the exact op set the bisect above already
+    proves lowerable.  ceil(log2(S)) fully-vectorized O(S) rounds."""
+    s = at.shape[0]
+
+    def cum_incl(j):
+        return jnp.where(slots <= j, at, 0).sum()
+
+    # Smallest j with cumulative(at[0..j]) >= need; s if need > total.
+    def bisect(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        ok = cum_incl(mid) >= need
+        return (jnp.where(ok, lo, mid), jnp.where(ok, mid, hi))
+
+    iters = max(1, int(np.ceil(np.log2(s + 1))) + 1)
+    _, jstar = jax.lax.fori_loop(
+        0, iters, bisect, (jnp.int32(-1), jnp.int32(s)))
+    rem = need - jnp.where(slots < jstar, at, 0).sum()
+    return jnp.where(
+        slots < jstar, at,
+        jnp.where(slots == jstar, jnp.clip(rem, 0, at), 0))
+
+
 def _kernel_body(cm: DispatchCostModel):
     # Plain Python ints: jnp scalars here would be captured as traced
     # constants, which pallas_call refuses.
@@ -51,7 +84,7 @@ def _kernel_body(cm: DispatchCostModel):
         alive_ref, capacity_ref, running_in_ref, dedicated_ref,
         version_ref, env_bitmap_ref,   # transposed: (e_words, S)
         # outputs
-        counts_ref,                    # (1, S) block per group
+        counts_ref,                    # full (G, S) block, row-selected
         running_out_ref,
         # scratch
         running_scratch,
@@ -61,6 +94,7 @@ def _kernel_body(cm: DispatchCostModel):
         @pl.when(g == 0)
         def _():
             running_scratch[:] = running_in_ref[:]
+            counts_ref[:, :] = jnp.zeros_like(counts_ref)
 
         running = running_scratch[:]
         s = running.shape[0]
@@ -108,11 +142,17 @@ def _kernel_body(cm: DispatchCostModel):
         below = count_leq(tau - 1)
         at = count_leq(tau) - below
         need_at = m - below.sum()
-        cum_before = jnp.cumsum(at) - at
-        take_at = jnp.clip(need_at - cum_before, 0, at)
+        take_at = _take_lowest_slots(at, need_at, slots)
         counts = (below + take_at).astype(jnp.int32)
 
-        counts_ref[0, :] = counts
+        # Mosaic rejects sub-tile (1, S) row blocks on a (G, S) output
+        # (last two block dims must be (8k, 128k) or the full array), so
+        # the output rides ONE full-array block revisited every step and
+        # the row lands via an iota select — a (G, S) vector op, cheap
+        # at dispatch sizes.
+        row = jax.lax.broadcasted_iota(jnp.int32, counts_ref.shape, 0)
+        counts_ref[:, :] = jnp.where(row == g, counts[None, :],
+                                     counts_ref[:, :])
         running_scratch[:] = running + counts
 
         @pl.when(g == pl.num_programs(0) - 1)
@@ -138,8 +178,8 @@ def pallas_assign_grouped(
         grid=(g,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
         out_specs=[
-            pl.BlockSpec((1, s), lambda i, *_: (i, 0),
-                         memory_space=pltpu.VMEM),  # counts
+            pl.BlockSpec((g, s), lambda i, *_: (0, 0),
+                         memory_space=pltpu.VMEM),  # counts (full block)
             pl.BlockSpec((s,), lambda i, *_: (0,),
                          memory_space=pltpu.VMEM),  # running_out
         ],
