@@ -3,9 +3,15 @@
 Simulates the BASELINE.json target scenario — a 5000-servant pool with
 heterogeneous capacities and environments, grant requests arriving in
 micro-batches — and measures end-to-end dispatch throughput through the
-same path the production JaxGroupedPolicy uses (host snapshot upload +
-one jitted threshold-search per descriptor group + counts download),
-plus per-batch latency percentiles.
+same path the production JaxGroupedPolicy uses (per-batch descriptor
+upload + one jitted threshold-search per descriptor group + counts
+download), plus per-batch latency percentiles.  The loop is PIPELINED:
+`running` stays device-resident across batches and counts stream back
+via async D2H with a window of batches in flight — the production
+dispatch shape, and the only honest measurement on this harness's
+remote-attached accelerator, where every synchronous D2H fetch pays a
+flat ~70ms tunnel round-trip (reported as tunnel_d2h_rtt_ms; on a
+host-attached deployment it is microseconds).
 
 Target (BASELINE.md): >= 50,000 assignments/sec with p99 dispatch
 latency < 2ms.  The child prints a complete JSON line after the
@@ -36,27 +42,109 @@ def _occupancy_trimmer(static, target: float = 0.55):
     """Shared steady-state model: a closure retiring grants (the
     FreeTask stream) so occupancy hovers around `target` — used
     identically by the headline loop and both Pallas A/Bs so their
-    numbers stay comparable."""
+    numbers stay comparable.
+
+    Fully device-resident: the original version synced occupancy to the
+    host every batch (`device_get(running.sum())`), which on a remote-
+    attached accelerator costs a full D2H round-trip (~70ms on the axon
+    tunnel, measured) and single-handedly capped the pipeline.  The
+    occupancy test now rides inside the jitted trim itself."""
     import jax
     import jax.numpy as jnp
 
     capacity = np.asarray(static["capacity"])
     alive = np.asarray(static["alive"])
     total_capacity = int(capacity[alive].sum())
+    target_occ = jnp.float32(target * total_capacity)
 
     @jax.jit
-    def free_fraction(running, frac):
+    def trim(running):
+        occ = running.sum().astype(jnp.float32)
+        frac = jnp.where(occ > target_occ,
+                         (occ - target_occ) / jnp.maximum(occ, 1.0),
+                         0.0)
         freed = (running.astype(jnp.float32) * frac).astype(jnp.int32)
         return jnp.maximum(running - freed, 0)
 
-    def trim(running):
-        occ = int(jax.device_get(running.sum()))
-        extra = occ - target * total_capacity
-        if extra > 0:
-            return free_fraction(running, jnp.float32(extra / max(occ, 1)))
-        return running
-
     return trim
+
+
+def _measure_d2h_rtt(n: int = 5) -> float:
+    """Median round-trip of a fresh single-scalar device->host transfer.
+    On co-located hardware this is microseconds; on the harness's
+    tunnelled accelerator it is a flat ~70ms per synchronous fetch —
+    the number that makes pipelining (not per-batch sync) the only
+    honest way to measure dispatch throughput here."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.int32(0)
+    rtts = []
+    for _ in range(n):
+        x = f(x)
+        t0 = time.perf_counter()
+        int(x)                       # fresh result: forced D2H sync
+        rtts.append(time.perf_counter() - t0)
+    rtts.sort()
+    return rtts[len(rtts) // 2] * 1000.0
+
+
+def _pipelined_run(step_fn, make_batch_fn, running, trim,
+                   batches: int, warmup: int, window: int,
+                   count_fn=None):
+    """The shared measurement harness: drive `step_fn` (upload ->
+    kernel -> async D2H) with `window` batches in flight, the
+    production dispatch shape for a device that is not host-attached.
+
+    Per-batch latency is submit -> counts-on-host (includes the real
+    transport RTT); throughput is completed grants / wall time.
+    Returns (running, grants/s, latencies_s, elapsed_s)."""
+    import collections
+
+    import numpy as np
+
+    inflight = collections.deque()
+    granted = 0
+    latencies = []
+
+    if count_fn is None:
+        count_fn = lambda arr: int(arr.sum())   # grant-count vectors
+
+    def drain_one():
+        nonlocal granted
+        t_submit, result = inflight.popleft()
+        arr = np.asarray(result)           # ready or nearly so
+        latencies.append(time.perf_counter() - t_submit)
+        granted += count_fn(arr)
+
+    # Warmup flows through the same pipeline, then the clock starts.
+    for i in range(warmup):
+        counts, running = step_fn(make_batch_fn(i), running)
+        counts.copy_to_host_async()
+        if trim is not None:        # None = trim fused into step_fn
+            running = trim(running)
+        inflight.append((time.perf_counter(), counts))
+        if len(inflight) > window:
+            drain_one()
+    while inflight:
+        drain_one()
+    granted, latencies = 0, []
+
+    t_start = time.perf_counter()
+    for i in range(batches):
+        t0 = time.perf_counter()
+        counts, running = step_fn(make_batch_fn(i), running)
+        counts.copy_to_host_async()
+        if trim is not None:
+            running = trim(running)
+        inflight.append((t0, counts))
+        if len(inflight) > window:
+            drain_one()
+    while inflight:
+        drain_one()
+    elapsed = time.perf_counter() - t_start
+    return running, granted / elapsed, latencies, elapsed
 
 
 def main() -> None:
@@ -111,29 +199,52 @@ def main() -> None:
     # around the target instead of sawtoothing to empty.
     trim = _occupancy_trimmer(static)
 
-    granted = 0
-    latencies = []
-    start_all = None
-    for i in range(WARMUP + BATCHES):
-        groups = _make_groups(rng, T, G, E_WORDS)
-        t0 = time.perf_counter()
-        pool = asn.PoolArrays(running=running, **static)
-        batch = asg.make_grouped_batch(groups, pad_to=G_PAD)
-        counts, running = asg.assign_grouped(pool, batch)
-        counts.block_until_ready()
-        t1 = time.perf_counter()
-        # Untimed: retiring grants rides the FreeTask/heartbeat stream,
-        # not the grant critical path.
-        running = trim(running)
-        if i < WARMUP:
-            start_all = time.perf_counter()
-            continue
-        latencies.append(t1 - t0)
-        granted += int(np.asarray(counts).sum())
-    elapsed = time.perf_counter() - start_all
+    # The pipelined dispatch loop: `running` lives on device the whole
+    # time, counts stream back via async D2H with WINDOW batches in
+    # flight.  This is the production shape — the dispatcher applies
+    # batch i's grants while batch i+1..i+W compute — and the only
+    # honest one on a remote-attached device (one synchronous D2H costs
+    # a full transport RTT; see tunnel_d2h_rtt_ms in the output).
+    WINDOW = int(os.environ.get("BENCH_WINDOW", 64))
+    T_PAD = asg.task_pad(T)
 
-    per_sec = granted / elapsed
+    # The production JaxGroupedPolicy device path, fully fused: ONE
+    # [4, G] descriptor upload, ONE dispatch (threshold search +
+    # on-device expansion + the FreeTask trim), ONE int32[T] picks
+    # download (2KB, vs the 80KB counts matrix).  Every extra device
+    # op costs ~1ms of dispatch on a remote-attached accelerator, so
+    # the step is a single executable.
+    @jax.jit
+    def step(packed, running):
+        picks, new_running = asg.assign_grouped_picks_packed(
+            asn.PoolArrays(running=running, **static), packed, T_PAD)
+        return picks, trim(new_running)
+
+    def mkbatch(_i):
+        return asg.make_grouped_packed(
+            _make_groups(rng, T, G, E_WORDS), pad_to=G_PAD)
+
+    count_picks = lambda arr: int((arr >= 0).sum())
+    running, per_sec, _, elapsed = _pipelined_run(
+        step, mkbatch, running, trim=None,
+        batches=BATCHES, warmup=WARMUP + 5, window=WINDOW,
+        count_fn=count_picks)
+    # Latency is measured in a separate shallow-window run: with a deep
+    # window, submit->drain latency is just window x service time (a
+    # knob, not a property of the kernel).  Window 2 keeps one batch
+    # overlapping the drain — the adaptive-dispatch shape under light
+    # load — so p99 here is service + transport RTT.
+    LAT_WINDOW = 2
+    running, _, latencies, _ = _pipelined_run(
+        step, mkbatch, running, trim=None,
+        batches=min(BATCHES, 60), warmup=2, window=LAT_WINDOW,
+        count_fn=count_picks)
     p99_ms = float(np.percentile(np.array(latencies) * 1000, 99))
+    rtt_ms = _measure_d2h_rtt()
+    # Per-batch pipeline service time: what each batch adds to the
+    # steady-state stream — the latency floor a host-attached deploy
+    # would see (RTT there is microseconds, not the tunnel's ~70ms).
+    service_ms = elapsed * 1000.0 / max(1, BATCHES)
     target = 50_000.0
 
     # Secondary metric: grants/sec through the FULL TaskDispatcher —
@@ -149,6 +260,10 @@ def main() -> None:
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
         "p99_batch_latency_ms": round(p99_ms, 3),
+        "latency_mode_window": LAT_WINDOW,
+        "pipeline_service_ms_per_batch": round(service_ms, 3),
+        "tunnel_d2h_rtt_ms": round(rtt_ms, 2),
+        "pipeline_window": WINDOW,
         "batch_size": T,
         "pool_size": S,
         "kernel": "grouped",
@@ -229,7 +344,7 @@ def _heartbeat_throughput(n_servants: int = 5000, n: int = 10000) -> float:
     return round(n / dt, 1)
 
 
-def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
+def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 150) -> dict:
     """Native-compile the Pallas kernel at the production shape, check
     parity against the exact scan kernel, and time it.  TPU only (the
     interpreter path is parity-tested in CI instead)."""
@@ -250,29 +365,31 @@ def _pallas_ab(static, S, T, E_WORDS, rng, batches: int = 30) -> dict:
         np.array_equal(np.asarray(p_picks), np.asarray(s_picks))
         and np.array_equal(np.asarray(p_running), np.asarray(s_running)))
 
-    # Same steady-state shape as the headline loop (shared trimmer), so
-    # the two numbers are comparable at the same ~55% occupancy.
+    # Same steady-state shape and pipelined harness as the headline
+    # loop, so the numbers are directly comparable.  Grants are counted
+    # as picks >= 0, mapped through the same drain path by summing a
+    # device-side 0/1 vector.
     trim = _occupancy_trimmer(static)
-    granted = 0
-    t0 = time.perf_counter()
-    elapsed = 0.0
-    for _ in range(batches):
-        p_picks, running = pallas_assign_batch(
-            asn.PoolArrays(running=running, **static), batch)
-        p_picks.block_until_ready()
-        elapsed += time.perf_counter() - t0
-        granted += int((np.asarray(p_picks) >= 0).sum())
-        running = trim(running)
-        t0 = time.perf_counter()
+
+    @jax.jit
+    def step(b, running):
+        picks, running = pallas_assign_batch(
+            asn.PoolArrays(running=running, **static), b)
+        return (picks >= 0).astype(jnp.int32), trim(running)
+
+    running, per_sec, _, _ = _pipelined_run(
+        step, lambda _i: batch, running, trim=None,
+        batches=batches, warmup=3,
+        window=int(os.environ.get("BENCH_WINDOW", 64)))
     return {
         "native_compile_ok": True,
         "parity_with_scan_kernel": parity,
-        "assignments_per_sec": round(granted / elapsed, 1),
+        "assignments_per_sec": round(per_sec, 1),
     }
 
 
 def _pallas_grouped_ab(static, S, T, E_WORDS, G, G_PAD, rng,
-                       batches: int = 30) -> dict:
+                       batches: int = 150) -> dict:
     """The headline grouped workload through the single-launch Pallas
     kernel: parity vs the XLA grouped kernel, then timed at the same
     steady-state occupancy."""
@@ -281,7 +398,8 @@ def _pallas_grouped_ab(static, S, T, E_WORDS, G, G_PAD, rng,
 
     from yadcc_tpu.ops import assignment as asn
     from yadcc_tpu.ops import assignment_grouped as asg
-    from yadcc_tpu.ops.pallas_grouped import pallas_assign_grouped
+    from yadcc_tpu.ops.pallas_grouped import (
+        pallas_assign_grouped, pallas_assign_grouped_picks_packed)
 
     running = jnp.zeros(S, jnp.int32)
     pool = asn.PoolArrays(running=running, **static)
@@ -294,23 +412,27 @@ def _pallas_grouped_ab(static, S, T, E_WORDS, G, G_PAD, rng,
         and np.array_equal(np.asarray(p_running), np.asarray(x_running)))
 
     trim = _occupancy_trimmer(static)
-    granted = 0
-    elapsed = 0.0
-    t0 = time.perf_counter()
-    for _ in range(batches):
-        batch = asg.make_grouped_batch(_make_groups(rng, T, G, E_WORDS),
+    t_pad = asg.task_pad(T)
+
+    @jax.jit
+    def step(packed, running):
+        picks, running = pallas_assign_grouped_picks_packed(
+            asn.PoolArrays(running=running, **static), packed, t_pad)
+        return picks, trim(running)
+
+    def mkbatch(_i):
+        return asg.make_grouped_packed(_make_groups(rng, T, G, E_WORDS),
                                        pad_to=G_PAD)
-        counts, running = pallas_assign_grouped(
-            asn.PoolArrays(running=running, **static), batch)
-        counts.block_until_ready()
-        elapsed += time.perf_counter() - t0
-        granted += int(np.asarray(counts).sum())
-        running = trim(running)
-        t0 = time.perf_counter()
+
+    running, per_sec, _, _ = _pipelined_run(
+        step, mkbatch, running, trim=None,
+        batches=batches, warmup=3,
+        window=int(os.environ.get("BENCH_WINDOW", 64)),
+        count_fn=lambda arr: int((arr >= 0).sum()))
     return {
         "native_compile_ok": True,
         "parity_with_xla_grouped": parity,
-        "assignments_per_sec": round(granted / elapsed, 1),
+        "assignments_per_sec": round(per_sec, 1),
     }
 
 

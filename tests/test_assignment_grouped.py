@@ -175,3 +175,70 @@ class TestGroupedVsOracle:
         assert np.array_equal(got, want)
         assert got[0, 0] == 3 and got[1, 0] == 2
         assert int(got_run[0]) == 5
+
+
+class TestDeviceExpansion:
+    """expand_counts / assign_grouped_picks: the on-device twin of the
+    host np.repeat expansion (the D2H-thin path JaxGroupedPolicy uses
+    on TPU)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_expand_matches_host_repeat(self, seed):
+        rng = np.random.default_rng(seed)
+        g, s = int(rng.integers(1, 5)), 64
+        counts = rng.integers(0, 4, (g, s)).astype(np.int32)
+        # Group sizes sometimes exceed the granted total (infeasible
+        # remainder -> NO_PICK tail), sometimes match it exactly.
+        sizes = np.array(
+            [counts[i].sum() + int(rng.integers(0, 3)) for i in range(g)],
+            np.int32)
+        t_max = asg.task_pad(int(sizes.sum()), floor=8)
+        got = np.asarray(asg.expand_counts(
+            jnp.asarray(counts), jnp.asarray(sizes), t_max))
+        want = np.full(t_max, asn.NO_PICK, np.int32)
+        off = 0
+        for i in range(g):
+            slots = np.repeat(np.arange(s), counts[i])
+            want[off:off + len(slots)] = slots
+            off += int(sizes[i])
+        assert np.array_equal(got, want)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fused_picks_match_two_step(self, seed):
+        rng = np.random.default_rng(seed + 100)
+        s = 96
+        pool_np = random_pool_np(rng, s)
+        groups = [
+            (int(rng.integers(0, 256)), 1, -1, int(rng.integers(1, 30)))
+            for _ in range(int(rng.integers(1, 5)))
+        ]
+        pool = to_pool_arrays(pool_np)
+        batch = asg.make_grouped_batch(groups, pad_to=8)
+        t_max = asg.task_pad(sum(m for *_, m in groups), floor=8)
+        picks, run_a = asg.assign_grouped_picks(pool, batch, t_max)
+        counts, run_b = asg.assign_grouped(pool, batch)
+        assert np.array_equal(np.asarray(run_a), np.asarray(run_b))
+        want = np.asarray(asg.expand_counts(counts, batch.count, t_max))
+        assert np.array_equal(np.asarray(picks), want)
+
+    def test_policy_device_expansion_matches_host(self, monkeypatch):
+        from yadcc_tpu.scheduler.policy import (AssignRequest,
+                                                JaxGroupedPolicy,
+                                                PoolSnapshot)
+
+        rng = np.random.default_rng(7)
+        s = 64
+        pool_np = random_pool_np(rng, s)
+        snap = PoolSnapshot(
+            alive=pool_np["alive"], capacity=pool_np["capacity"],
+            running=pool_np["running"], dedicated=pool_np["dedicated"],
+            version=pool_np["version"], env_bitmap=pool_np["env_bitmap"])
+        reqs = []
+        for _ in range(5):
+            e = int(rng.integers(0, 256))
+            reqs += [AssignRequest(e, 1, -1)] * int(rng.integers(1, 9))
+        monkeypatch.setenv("YTPU_GROUPED_EXPAND", "host")
+        host = JaxGroupedPolicy(max_groups=8).assign(snap, reqs)
+        monkeypatch.setenv("YTPU_GROUPED_EXPAND", "device")
+        dev = JaxGroupedPolicy(max_groups=8).assign(snap, reqs)
+        assert dev == host

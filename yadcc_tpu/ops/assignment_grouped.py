@@ -198,6 +198,66 @@ def assign_grouped(
     return counts, running
 
 
+def expand_counts(counts: jax.Array, sizes: jax.Array,
+                  t_max: int) -> jax.Array:
+    """Device-side grant expansion: (G, S) per-servant counts -> flat
+    per-request slot picks, int32[t_max].
+
+    Position t belongs to group g(t) (groups laid out consecutively by
+    `sizes`); within its group it takes the q-th grant, where grants
+    enumerate slots ascending with multiplicity counts[g, s] — exactly
+    the host-side `np.repeat(slot, counts)` expansion this replaces.
+    Entries past a group's granted total (infeasible remainder) and
+    past the batch total are NO_PICK.
+
+    Why on device: the host only ever needs ONE slot per request, so
+    downloading the full counts matrix (G*S ints) to expand it on the
+    host wastes D2H bandwidth O(G*S/T) — at the 5k-pool benchmark
+    shape that is 80KB down per 2KB of answer, and on a remote-attached
+    accelerator the transfer dominates the whole dispatch cycle.  The
+    dense one-hot compare below is ~t_max*S int ops, noise for the VPU.
+    """
+    from .assignment import NO_PICK
+
+    g_n, s = counts.shape
+    c = jnp.cumsum(counts, axis=1)                     # [G, S] inclusive
+    offs_incl = jnp.cumsum(sizes)                      # [G]
+    offs_excl = offs_incl - sizes
+    t_idx = jnp.arange(t_max, dtype=jnp.int32)
+    # Group of each flat position: how many group ends are <= t.
+    g_t = (offs_incl[None, :] <= t_idx[:, None]).sum(1)
+    in_batch = g_t < g_n
+    g_tc = jnp.clip(g_t, 0, g_n - 1)
+    q = t_idx - offs_excl[g_tc]                        # rank within group
+    c_rows = jnp.take(c, g_tc, axis=0)                 # [t_max, S]
+    pick = (c_rows <= q[:, None]).sum(1).astype(jnp.int32)
+    granted = q < c_rows[:, -1]     # group may grant fewer than asked
+    return jnp.where(in_batch & granted, pick, NO_PICK)
+
+
+@functools.partial(jax.jit, static_argnames=("t_max", "cost_model"))
+def assign_grouped_picks(
+    pool: PoolArrays,
+    batch: GroupedBatch,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused grouped assignment + on-device expansion: ONE launch, and
+    the D2H payload is int32[t_max] picks instead of the (G, S) counts
+    matrix — the minimal bytes the dispatcher actually consumes."""
+    counts, running = assign_grouped(pool, batch, cost_model)
+    return expand_counts(counts, batch.count, t_max), running
+
+
+def task_pad(n: int, floor: int = 256) -> int:
+    """Pad policy for the flat picks length (power of two, floored),
+    mirroring group_pad: tight for common sizes, tiny shape set."""
+    pad = floor
+    while pad < n:
+        pad *= 2
+    return pad
+
+
 def group_pad(n: int, floor: int = 4) -> int:
     """THE production shape policy: pad the group count to the next
     power of two with a floor.  The kernel's cost scales with the
@@ -212,6 +272,46 @@ def group_pad(n: int, floor: int = 4) -> int:
     return pad
 
 
+def make_grouped_packed(groups, pad_to: int) -> jax.Array:
+    """groups: [(env_id, min_version, requestor, count)] -> ONE [4, G]
+    int32 device block (a single H2D transfer).  Unpack on device with
+    `unpack_grouped` INSIDE a jitted caller: slicing on the host side
+    would issue four separate device ops per dispatch cycle, and on a
+    remote-attached accelerator each op costs ~1ms of dispatch."""
+    g = len(groups)
+    assert g <= pad_to
+    a = np.zeros((4, pad_to), np.int32)
+    a[2, :] = -1               # requestor padding: "no self-avoid slot"
+    if g:                      # count padding stays 0: grants nothing
+        a[:, :g] = np.asarray(groups, np.int32).T
+    return jnp.asarray(a)
+
+
+def unpack_grouped(packed: jax.Array) -> GroupedBatch:
+    """[4, G] block -> GroupedBatch row views (trace-time no-ops when
+    called inside jit)."""
+    return GroupedBatch(
+        env_id=packed[0],
+        min_version=packed[1],
+        requestor=packed[2],
+        count=packed[3],
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("t_max", "cost_model"))
+def assign_grouped_picks_packed(
+    pool: PoolArrays,
+    packed: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, jax.Array]:
+    """assign_grouped_picks taking the packed [4, G] descriptor block:
+    one upload, one dispatch, one O(T) download — the minimal
+    per-cycle device traffic for a grouped dispatch."""
+    return assign_grouped_picks(pool, unpack_grouped(packed), t_max,
+                                cost_model)
+
+
 def make_grouped_batch(groups, pad_to: int) -> GroupedBatch:
     """groups: [(env_id, min_version, requestor, count)], host-side.
 
@@ -219,16 +319,4 @@ def make_grouped_batch(groups, pad_to: int) -> GroupedBatch:
     [4, G] int32 block, unpacked lazily as row views): per-grant-cycle
     dispatch overhead is part of the p99 latency budget, and four
     separate tiny uploads cost ~4x one."""
-    g = len(groups)
-    assert g <= pad_to
-    a = np.zeros((4, pad_to), np.int32)
-    a[2, :] = -1               # requestor padding: "no self-avoid slot"
-    if g:                      # count padding stays 0: grants nothing
-        a[:, :g] = np.asarray(groups, np.int32).T
-    packed = jnp.asarray(a)
-    return GroupedBatch(
-        env_id=packed[0],
-        min_version=packed[1],
-        requestor=packed[2],
-        count=packed[3],
-    )
+    return unpack_grouped(make_grouped_packed(groups, pad_to))

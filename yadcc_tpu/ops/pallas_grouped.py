@@ -207,3 +207,41 @@ def pallas_assign_grouped(
         pool.env_bitmap.T,
     )
     return counts, running
+
+
+@functools.partial(
+    jax.jit, static_argnames=("t_max", "cost_model", "interpret"))
+def pallas_assign_grouped_picks(
+    pool: PoolArrays,
+    batch: GroupedBatch,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pallas grouped kernel + on-device expansion in ONE executable:
+    XLA splices the pallas call and the expansion into a single launch,
+    so the D2H payload is the int32[t_max] picks the dispatcher
+    actually consumes (see assignment_grouped.expand_counts)."""
+    from .assignment_grouped import expand_counts
+
+    counts, running = pallas_assign_grouped(
+        pool, batch, cost_model, interpret=interpret)
+    return expand_counts(counts, batch.count, t_max), running
+
+
+@functools.partial(
+    jax.jit, static_argnames=("t_max", "cost_model", "interpret"))
+def pallas_assign_grouped_picks_packed(
+    pool: PoolArrays,
+    packed: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Packed-descriptor variant: one [4, G] upload, one dispatch
+    (see assignment_grouped.assign_grouped_picks_packed)."""
+    from .assignment_grouped import unpack_grouped
+
+    return pallas_assign_grouped_picks(
+        pool, unpack_grouped(packed), t_max, cost_model,
+        interpret=interpret)

@@ -237,12 +237,70 @@ class JaxGroupedPolicy(DispatchPolicy):
 
     name = "jax_grouped"
 
+    # Device-expansion chunks are also capped by task count so the
+    # picks-length pad ladder {task_pad floor .. _TASK_CAP} is a small
+    # CLOSED set — warmup() compiles every member, so a live grant
+    # cycle can never hit an uncompiled shape no matter the backlog.
+    _TASK_CAP = 2048
+
     def __init__(self, max_groups: int = 64,
                  cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
         self._cm = cost_model
         self._max_groups = max_groups
         self._pool_cache = _DevicePoolCache()
         self._warmed_pool_shapes: set = set()
+        # None = decide on first use: device expansion where D2H bytes
+        # are precious (TPU — the counts matrix is O(S) per group while
+        # the picks answer is O(T)), host expansion on CPU where the
+        # transfer is free and numpy repeat is faster than a dense
+        # T x S compare.  YTPU_GROUPED_EXPAND={device,host} overrides
+        # (parity tests drive both routes on any platform).
+        self._expand_on_device: "bool | None" = None
+
+    def _decide_expand(self) -> bool:
+        if self._expand_on_device is None:
+            import os
+
+            import jax
+
+            forced = os.environ.get("YTPU_GROUPED_EXPAND")
+            if forced in ("device", "host"):
+                self._expand_on_device = forced == "device"
+            else:
+                self._expand_on_device = (
+                    jax.devices()[0].platform == "tpu")
+        return self._expand_on_device
+
+    def _run_picks_kernel(self, pool, packed, t_max: int):
+        """Hook: fused assignment + on-device expansion, taking the
+        packed [4, G] descriptor block (one upload, one dispatch)."""
+        from ..ops import assignment_grouped as asg
+
+        return asg.assign_grouped_picks_packed(pool, packed, t_max,
+                                               self._cm)
+
+    def _chunk_runs(self, runs):
+        """Split the run list into kernel-sized chunks: at most
+        _max_groups runs AND (so the fused picks shape set stays the
+        warmed ladder) at most _TASK_CAP member requests per chunk.
+        A single run longer than the cap is split across chunks —
+        correct because consecutive chunks carry `running` through,
+        exactly like consecutive groups do."""
+        chunks, cur, cur_tasks = [], [], 0
+        for key, members in runs:
+            start = 0
+            while start < len(members):
+                if cur and (len(cur) >= self._max_groups
+                            or cur_tasks >= self._TASK_CAP):
+                    chunks.append(cur)
+                    cur, cur_tasks = [], 0
+                take = members[start:start + self._TASK_CAP - cur_tasks]
+                cur.append((key, take))
+                cur_tasks += len(take)
+                start += len(take)
+        if cur:
+            chunks.append(cur)
+        return chunks
 
     def _run_grouped_kernel(self, pool, batch):
         from ..ops import assignment_grouped as asg
@@ -282,8 +340,21 @@ class JaxGroupedPolicy(DispatchPolicy):
             env_bitmap=jnp.zeros((pool_size, env_words), jnp.uint32))
         pad = asg.group_pad(0)
         while True:
-            self._run_grouped_kernel(
-                pool, asg.make_grouped_batch([], pad_to=pad))
+            if self._decide_expand():
+                # Full (group pad, task pad) ladder: assign() clamps
+                # chunks to _TASK_CAP tasks, so these are ALL the
+                # shapes the fused picks kernel can ever see.
+                t_pad = asg.task_pad(0)
+                while True:
+                    self._run_picks_kernel(
+                        pool, asg.make_grouped_packed([], pad_to=pad),
+                        t_pad)
+                    if t_pad >= self._TASK_CAP:
+                        break
+                    t_pad *= 2
+            else:
+                self._run_grouped_kernel(
+                    pool, asg.make_grouped_batch([], pad_to=pad))
             if pad >= self._max_groups:
                 break
             pad *= 2
@@ -302,14 +373,31 @@ class JaxGroupedPolicy(DispatchPolicy):
                 runs.append((key, [i]))
         picks = [asn.NO_PICK] * len(requests)
         running = snap.running.copy()
-        for start in range(0, len(runs), self._max_groups):
-            chunk = runs[start : start + self._max_groups]
+        expand_on_device = self._decide_expand()
+        for chunk in self._chunk_runs(runs):
             pad = asg.group_pad(len(chunk))
-            batch = asg.make_grouped_batch(
-                [(k[0], k[1], k[2], len(m)) for k, m in chunk],
-                pad_to=pad)
+            descr = [(k[0], k[1], k[2], len(m)) for k, m in chunk]
+            pool = self._prepare_grouped_pool(snap, running)
+            if expand_on_device:
+                # Fused kernel: the device hands back per-request slot
+                # picks directly — O(T) bytes down instead of the
+                # O(G*S) counts matrix, which on a remote-attached
+                # accelerator is the whole dispatch-cycle budget.
+                sizes = [len(m) for _, m in chunk]
+                t_pad = asg.task_pad(sum(sizes))
+                flat, new_running = self._run_picks_kernel(
+                    pool, asg.make_grouped_packed(descr, pad_to=pad),
+                    t_pad)
+                flat = np.asarray(flat)
+                running = np.asarray(new_running)
+                off = 0
+                for (_, member_idx), size in zip(chunk, sizes):
+                    for req_idx, s in zip(member_idx, flat[off:off + size]):
+                        picks[req_idx] = int(s)
+                    off += size
+                continue
             counts, new_running = self._run_grouped_kernel(
-                self._prepare_grouped_pool(snap, running), batch)
+                pool, asg.make_grouped_batch(descr, pad_to=pad))
             counts = np.asarray(counts)
             running = np.asarray(new_running)
             # Expand (group, slot)->count into per-request picks with
@@ -379,6 +467,9 @@ class JaxShardedGroupedPolicy(JaxGroupedPolicy):
         self._fn = pmesh.sharded_assign_grouped_fn(self._mesh, cost_model)
         self._shard = pmesh.shard_pool
         self._ndev = int(self._mesh.devices.size)
+        # The sharded kernel's counts live distributed over the mesh;
+        # expansion stays on the host until a sharded expand exists.
+        self._expand_on_device = False
 
     def _prepare_grouped_pool(self, snap, running):
         s = snap.alive.shape[0]
@@ -408,6 +499,15 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
         interpret = jax.devices()[0].platform != "tpu"
         return pallas_assign_grouped(pool, batch, self._cm,
                                      interpret=interpret)
+
+    def _run_picks_kernel(self, pool, packed, t_max: int):
+        import jax
+
+        from ..ops.pallas_grouped import pallas_assign_grouped_picks_packed
+
+        interpret = jax.devices()[0].platform != "tpu"
+        return pallas_assign_grouped_picks_packed(
+            pool, packed, t_max, self._cm, interpret=interpret)
 
 
 class JaxPallasPolicy(JaxBatchedPolicy):
