@@ -1,0 +1,268 @@
+"""Pipelined dispatch: the device-resident running chain.
+
+The pipelined loop launches policy work without blocking on the device
+round-trip and reconciles host-side mutations (frees, rejections, slot
+recycling) through per-launch delta uploads.  These tests drive the
+REAL dispatch thread (not run_dispatch_cycle_for_testing) and check the
+two things that matter:
+
+* outcome parity: with serialized requests the pipelined dispatcher
+  places grants exactly like the synchronous one;
+* the chain invariant: once drained, device running + pending
+  corrections == host authoritative running, even after churn (frees,
+  servant death, slot recycling, request timeouts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yadcc_tpu.scheduler.policy import JaxGroupedPolicy
+from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+
+
+def make_dispatcher(pipeline_depth, n_servants=24, capacity=4,
+                    max_servants=64, policy=None):
+    d = TaskDispatcher(
+        policy or JaxGroupedPolicy(max_groups=8),
+        max_servants=max_servants,
+        max_envs=64,
+        min_memory_for_new_task=1 << 30,
+        batch_window_s=0.0,
+        pipeline_depth=pipeline_depth,
+        start_dispatch_thread=True,
+    )
+    for i in range(n_servants):
+        assert d.keep_servant_alive(servant(i, capacity), 3600.0)
+    return d
+
+
+def servant(i, capacity=4, envs=("envA",)):
+    return ServantInfo(
+        location=f"10.0.{i >> 8}.{i & 255}:8335",
+        version=1, num_processors=32, capacity=capacity,
+        memory_available=64 << 30, env_digests=tuple(envs))
+
+
+def drain_idle(d, policy, timeout=10.0):
+    """Wait until no launches are in flight and no requests pending."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with d._lock:
+            idle = not d._pending and all(
+                r.inflight_imm == 0 and r.inflight_pre == 0
+                for r in d._pending)
+        if idle:
+            # One more beat for the loop to finish draining tickets.
+            time.sleep(0.3)
+            return
+        time.sleep(0.05)
+    pytest.fail("dispatcher did not go idle")
+
+
+def chain_invariant(d, policy):
+    """device running (+ pending deltas the host hasn't uploaded yet)
+    must equal host authoritative running for every non-reset slot."""
+    dev = np.asarray(policy._stream_running).astype(np.int64)
+    with d._lock:
+        host = d._arr_running.astype(np.int64).copy()
+        adj = d._pipe_adj.copy()
+        resets = dict(d._pipe_resets)
+    for slot in range(len(host)):
+        if slot in resets:
+            assert resets[slot] == host[slot], (
+                f"slot {slot}: pending reset {resets[slot]} vs host "
+                f"{host[slot]}")
+        else:
+            assert dev[slot] + adj[slot] == host[slot], (
+                f"slot {slot}: device {dev[slot]} + adj {adj[slot]} "
+                f"!= host {host[slot]}")
+
+
+class TestPipelinedBasics:
+    def test_grants_flow_and_capacity_respected(self):
+        policy = JaxGroupedPolicy(max_groups=8)
+        d = make_dispatcher(4, n_servants=6, capacity=2, policy=policy)
+        try:
+            grants = d.wait_for_starting_new_task(
+                "envA", immediate=8, timeout_s=10.0)
+            assert len(grants) == 8
+            per_servant = {}
+            for _, loc in grants:
+                per_servant[loc] = per_servant.get(loc, 0) + 1
+            assert all(v <= 2 for v in per_servant.values())
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+        finally:
+            d.stop()
+
+    def test_overload_grants_capped_at_pool_capacity(self):
+        policy = JaxGroupedPolicy(max_groups=8)
+        d = make_dispatcher(4, n_servants=4, capacity=2, policy=policy)
+        try:
+            grants = d.wait_for_starting_new_task(
+                "envA", immediate=50, timeout_s=2.0)
+            assert len(grants) == 8    # 4 servants x capacity 2
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+        finally:
+            d.stop()
+
+    def test_free_recycles_capacity_through_the_chain(self):
+        policy = JaxGroupedPolicy(max_groups=8)
+        d = make_dispatcher(2, n_servants=2, capacity=1, policy=policy)
+        try:
+            g1 = d.wait_for_starting_new_task(
+                "envA", immediate=2, timeout_s=10.0)
+            assert len(g1) == 2
+            d.free_task([gid for gid, _ in g1])
+            g2 = d.wait_for_starting_new_task(
+                "envA", immediate=2, timeout_s=10.0)
+            assert len(g2) == 2
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+        finally:
+            d.stop()
+
+
+class TestPipelinedParityWithSync:
+    def test_serialized_requests_match_sync_placement(self):
+        """With one request at a time (pipeline never deeper than one
+        outstanding item), placement must equal the sync dispatcher's:
+        both reduce to the same oracle-checked kernel decisions."""
+        placements = {}
+        for depth in (0, 4):
+            policy = JaxGroupedPolicy(max_groups=8)
+            d = make_dispatcher(depth, n_servants=5, capacity=3,
+                                policy=policy)
+            try:
+                locs = []
+                for _ in range(9):
+                    got = d.wait_for_starting_new_task(
+                        "envA", immediate=1, timeout_s=10.0)
+                    assert len(got) == 1
+                    locs.append(got[0][1])
+                placements[depth] = locs
+            finally:
+                d.stop()
+        assert placements[0] == placements[4]
+
+
+class TestPipelinedChurn:
+    def test_chain_survives_churn(self):
+        """Waiters, frees, servant death, slot recycling and request
+        timeouts racing against the pipeline; the chain invariant must
+        hold once quiescent."""
+        policy = JaxGroupedPolicy(max_groups=8)
+        d = make_dispatcher(4, n_servants=12, capacity=3, policy=policy)
+        stop = threading.Event()
+        errors = []
+
+        def waiter():
+            while not stop.is_set():
+                try:
+                    got = d.wait_for_starting_new_task(
+                        "envA", immediate=2, prefetch=1, timeout_s=0.5)
+                    if got and not stop.is_set():
+                        time.sleep(0.01)
+                        d.free_task([gid for gid, _ in got])
+                except Exception as e:   # pragma: no cover
+                    errors.append(e)
+                    return
+
+        def churner():
+            i = 0
+            while not stop.is_set():
+                try:
+                    # Kill one servant, register a replacement on the
+                    # (likely recycled) slot.
+                    victim = 12 + (i % 6)
+                    d.keep_servant_alive(servant(victim, 3), 3600.0)
+                    time.sleep(0.02)
+                    d.keep_servant_alive(servant(victim, 3), 0.0)
+                    i += 1
+                except Exception as e:   # pragma: no cover
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=waiter) for _ in range(4)]
+        threads.append(threading.Thread(target=churner))
+        for t in threads:
+            t.start()
+        time.sleep(3.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+        try:
+            # Release everything still held and let the stream settle.
+            d.free_task([g.grant_id for g in d.get_running_tasks()])
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+            # Host bookkeeping self-consistency.
+            with d._lock:
+                for s in d._slots:
+                    if s is not None:
+                        assert len(s.running_grants) == \
+                            d._arr_running[s.slot]
+                        assert len(s.running_grants) <= s.info.capacity
+        finally:
+            d.stop()
+
+
+class FlakyStreamPolicy(JaxGroupedPolicy):
+    """Raises on scripted stream calls to exercise the resync path."""
+
+    def __init__(self, fail_launches=(), fail_collects=(), **kw):
+        super().__init__(**kw)
+        self._fail_launches = set(fail_launches)
+        self._fail_collects = set(fail_collects)
+        self._launch_n = 0
+        self._collect_n = 0
+        self.begin_calls = 0
+
+    def stream_begin(self, snap):
+        self.begin_calls += 1
+        return super().stream_begin(snap)
+
+    def stream_launch(self, snap, descr, adj, reset_slots):
+        n = self._launch_n
+        self._launch_n += 1
+        if n in self._fail_launches:
+            raise RuntimeError(f"injected launch failure #{n}")
+        return super().stream_launch(snap, descr, adj, reset_slots)
+
+    def stream_collect(self, ticket):
+        n = self._collect_n
+        self._collect_n += 1
+        if n in self._fail_collects:
+            raise RuntimeError(f"injected collect failure #{n}")
+        return super().stream_collect(ticket)
+
+
+class TestPipelinedErrorRecovery:
+    @pytest.mark.parametrize("mode", ["launch", "collect"])
+    def test_device_error_resyncs_and_keeps_granting(self, mode):
+        policy = FlakyStreamPolicy(
+            fail_launches=(1,) if mode == "launch" else (),
+            fail_collects=(1,) if mode == "collect" else (),
+            max_groups=8)
+        d = make_dispatcher(4, n_servants=6, capacity=4, policy=policy)
+        try:
+            for _ in range(4):
+                got = d.wait_for_starting_new_task(
+                    "envA", immediate=3, prefetch=1, timeout_s=10.0)
+                assert len(got) >= 3
+                d.free_task([gid for gid, _ in got])
+            assert policy.begin_calls >= 2   # reseeded after the error
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+            with d._lock:
+                for r in d._pending:
+                    assert r.inflight_imm == 0 and r.inflight_pre == 0
+        finally:
+            d.stop()

@@ -312,6 +312,40 @@ def assign_grouped_picks_packed(
                                 cost_model)
 
 
+@functools.partial(jax.jit, static_argnames=("t_max", "cost_model"))
+def assign_grouped_picks_stream(
+    pool: PoolArrays,
+    packed: jax.Array,
+    adj: jax.Array,
+    reset_mask: jax.Array,
+    reset_val: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, jax.Array]:
+    """One step of the PIPELINED dispatch stream.
+
+    `pool.running` is the device-resident chained running array — the
+    output of the previous stream step, never downloaded.  The host
+    folds in everything it learned since the last launch as one delta
+    upload:
+
+    * `adj` int32[S]: signed corrections — task frees/expirations, and
+      grants a drained cycle issued on device but the host REJECTED at
+      apply time (stale slot, capacity re-check);
+    * `reset_mask`/`reset_val`: slots whose device value is no longer
+      trustworthy (servant died / slot recycled) are overwritten
+      absolutely with the host-authoritative count.
+
+    The invariant this maintains: device running = host authoritative
+    running + grants issued by still-in-flight launches.  One launch,
+    one [4, G] + O(S) upload, one O(T) picks download — the dispatch
+    cycle never blocks on device->host latency."""
+    running = jnp.where(reset_mask, reset_val,
+                        jnp.maximum(pool.running + adj, 0))
+    return assign_grouped_picks(pool._replace(running=running),
+                                unpack_grouped(packed), t_max, cost_model)
+
+
 def make_grouped_batch(groups, pad_to: int) -> GroupedBatch:
     """groups: [(env_id, min_version, requestor, count)], host-side.
 

@@ -52,6 +52,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="let a machine compile its own submissions via "
                         "the network path (single-machine rigs/tests; "
                         "normally wasteful, hence off)")
+    p.add_argument("--dispatch-pipeline-depth", default="auto",
+                   help="in-flight policy launches (device-resident "
+                        "running chain).  'auto' = 16 on an accelerator "
+                        "backend where device->host syncs are the cycle "
+                        "bottleneck, 0 (synchronous) on host platforms; "
+                        "an integer forces a depth")
     return p
 
 
@@ -71,6 +77,23 @@ def ensure_policy_backend(policy_name: str, probe=None) -> bool:
         logger=logger, expose_path="yadcc/policy_platform", probe=probe)
 
 
+def resolve_pipeline_depth(flag: str, policy) -> int:
+    """'auto' = pipeline on accelerator backends (where a synchronous
+    policy round-trip is the cycle bottleneck), synchronous on host
+    platforms; integers force.  Policies without the stream API always
+    run synchronously."""
+    if not getattr(policy, "supports_stream", False):
+        return 0
+    if flag != "auto":
+        return max(0, int(flag))
+    try:
+        import jax
+
+        return 16 if jax.devices()[0].platform == "tpu" else 0
+    except Exception:
+        return 0
+
+
 def scheduler_start(args) -> None:
     from ..common.parse_size import parse_size
     from ..utils.locktrace import install_from_env
@@ -80,15 +103,20 @@ def scheduler_start(args) -> None:
 
     policy = make_policy(args.dispatch_policy, args.max_servants,
                          avoid_self=not args.allow_self_dispatch)
+    depth = resolve_pipeline_depth(args.dispatch_pipeline_depth, policy)
     # Pre-compile the policy's device kernels for the serving shapes
     # BEFORE accepting requests: a mid-serving jit compile would stall
     # a live grant cycle for hundreds of ms.
-    policy.warmup(args.max_servants)
+    if depth > 0:
+        policy.stream_warmup(args.max_servants)
+    else:
+        policy.warmup(args.max_servants)
     dispatcher = TaskDispatcher(
         policy,
         max_servants=args.max_servants,
         min_memory_for_new_task=parse_size(
             args.servant_min_memory_for_new_task),
+        pipeline_depth=depth,
     )
     service = SchedulerService(
         dispatcher,

@@ -87,6 +87,9 @@ class DispatchPolicy:
     request order.  Returns a slot per request or assignment.NO_PICK."""
 
     name = "abstract"
+    # True when the policy implements the stream_* API (pipelined
+    # dispatch: launch without blocking on the device round-trip).
+    supports_stream = False
 
     def assign(self, snap: PoolSnapshot,
                requests: Sequence[AssignRequest]) -> List[int]:
@@ -96,6 +99,16 @@ class DispatchPolicy:
         """Pre-compile device kernels for the serving shapes (no-op for
         host policies).  Entry points call this before serving so the
         first real grant cycle never pays a jit compile."""
+
+
+@dataclass
+class StreamTicket:
+    """Handle for one in-flight pipelined launch: the device picks
+    buffer plus the launch sequence number (the dispatcher uses it to
+    order reset barriers against rejected-grant corrections)."""
+
+    launch_id: int
+    picks: object          # jax.Array, D2H copy already started
 
 
 class GreedyCpuPolicy(DispatchPolicy):
@@ -279,6 +292,103 @@ class JaxGroupedPolicy(DispatchPolicy):
         return asg.assign_grouped_picks_packed(pool, packed, t_max,
                                                self._cm)
 
+    # ------------------------------------------------------------------
+    # Pipelined dispatch stream (device-resident running chain).
+    #
+    # The sync assign() path blocks on the device round-trip every
+    # cycle; on a host-disaggregated accelerator (tens of ms RTT) that
+    # caps the whole scheduler at ~1/RTT cycles/s.  The stream API
+    # instead keeps `running` ON DEVICE between launches: the host
+    # folds its authoritative mutations (frees, rejected grants, slot
+    # resets) into per-launch delta uploads, and collects each
+    # launch's picks whenever the async D2H copy lands.  Invariant:
+    # device running = host running + grants of in-flight launches.
+    # ------------------------------------------------------------------
+
+    supports_stream = True
+
+    def stream_begin(self, snap) -> None:
+        """Absolute sync point: seed the device running chain from the
+        host-authoritative snapshot.  Call with no launches in flight
+        (startup, or recovery after a device error)."""
+        import jax.numpy as jnp
+
+        self._stream_running = jnp.asarray(snap.running)
+        self._stream_next_id = 0
+
+    def stream_warmup(self, pool_size: int, env_words: int = 8) -> None:
+        """Compile the stream kernel's (group pad, task pad) ladder —
+        the pipelined twin of warmup(); entry points call it before
+        enabling pipelined dispatch."""
+        import jax.numpy as jnp
+
+        from ..ops import assignment_grouped as asg
+
+        zeros = jnp.zeros(pool_size, jnp.int32)
+        pool = asn.PoolArrays(
+            alive=jnp.zeros(pool_size, bool),
+            capacity=zeros, running=zeros,
+            dedicated=jnp.zeros(pool_size, bool), version=zeros,
+            env_bitmap=jnp.zeros((pool_size, env_words), jnp.uint32))
+        falses = jnp.zeros(pool_size, bool)
+        pad = asg.group_pad(0)
+        while True:
+            t_pad = asg.task_pad(0)
+            while True:
+                self._run_stream_kernel(
+                    pool, asg.make_grouped_packed([], pad_to=pad),
+                    zeros, falses, zeros, t_pad)
+                if t_pad >= self._TASK_CAP:
+                    break
+                t_pad *= 2
+            if pad >= self._max_groups:
+                break
+            pad *= 2
+
+    def _run_stream_kernel(self, pool, packed, adj, rmask, rval,
+                           t_max: int):
+        from ..ops import assignment_grouped as asg
+
+        return asg.assign_grouped_picks_stream(
+            pool, packed, adj, rmask, rval, t_max, self._cm)
+
+    def stream_launch(self, snap, descr, adj, reset_slots) -> StreamTicket:
+        """Launch one chunk without waiting for the result.
+
+        snap: PoolSnapshot for statics + per-launch capacity (its
+        `running` is IGNORED — the device chain is authoritative).
+        descr: [(env_id, min_version, requestor_slot, count)] runs, in
+        work order; the flat picks positions map 1:1 to that order.
+        adj: int32[S] signed host corrections since the last launch.
+        reset_slots: {slot: absolute_running} overrides."""
+        import jax.numpy as jnp
+
+        from ..ops import assignment_grouped as asg
+
+        pool = _upload_pool(snap, self._stream_running, self._pool_cache)
+        packed = asg.make_grouped_packed(
+            descr, pad_to=asg.group_pad(len(descr)))
+        s = snap.alive.shape[0]
+        rmask = np.zeros(s, bool)
+        rval = np.zeros(s, np.int32)
+        for slot, val in reset_slots.items():
+            rmask[slot] = True
+            rval[slot] = val
+        t_pad = asg.task_pad(sum(d[3] for d in descr))
+        picks, self._stream_running = self._run_stream_kernel(
+            pool, packed, jnp.asarray(adj.astype(np.int32)),
+            jnp.asarray(rmask), jnp.asarray(rval), t_pad)
+        picks.copy_to_host_async()
+        ticket = StreamTicket(self._stream_next_id, picks)
+        self._stream_next_id += 1
+        return ticket
+
+    def stream_ready(self, ticket: StreamTicket) -> bool:
+        return ticket.picks.is_ready()
+
+    def stream_collect(self, ticket: StreamTicket) -> np.ndarray:
+        return np.asarray(ticket.picks)
+
     def _chunk_runs(self, runs):
         """Split the run list into kernel-sized chunks: at most
         _max_groups runs AND (so the fused picks shape set stays the
@@ -457,6 +567,8 @@ class JaxShardedGroupedPolicy(JaxGroupedPolicy):
     TestShardedGroupedAssign."""
 
     name = "jax_sharded_grouped"
+    # The stream kernel is the local XLA one; no sharded stream yet.
+    supports_stream = False
 
     def __init__(self, max_groups: int = 64,
                  cost_model: DispatchCostModel = DEFAULT_COST_MODEL):

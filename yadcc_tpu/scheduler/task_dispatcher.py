@@ -93,6 +93,11 @@ class _Pending:
     deadline: float
     first_cycle_done: bool = False
     abandoned: bool = False  # caller gave up; grants must not be issued
+    # Pipelined mode: entries launched but not yet drained.  Selection
+    # subtracts these so a request in flight is never launched twice.
+    inflight_imm: int = 0
+    inflight_pre: int = 0
+    prefetch_launched: bool = False
     grants: List[_Grant] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
 
@@ -109,6 +114,7 @@ class TaskDispatcher:
         batch_window_s: float = 0.002,
         batch_target: int = 64,
         start_dispatch_thread: bool = True,
+        pipeline_depth: int = 0,
     ):
         self._policy = policy
         self._clock = clock
@@ -166,10 +172,30 @@ class TaskDispatcher:
         self._stopping = False
         self._stats = {"granted": 0, "expired_grants": 0, "zombies_killed": 0}
 
+        # Pipelined dispatch (device-resident running chain): the host
+        # folds mutations it makes between launches into a per-launch
+        # delta upload.  _pipe_adj accumulates signed running
+        # corrections (frees, host-rejected device grants); _pipe_resets
+        # marks slots needing an absolute overwrite (death/recycle);
+        # _pipe_reset_barrier records WHICH launch carried each slot's
+        # last reset so corrections from launches before the reset are
+        # discarded (the reset already erased their effect).
+        self._pipeline_depth = pipeline_depth
+        self._pipelined = bool(
+            pipeline_depth > 0
+            and getattr(policy, "supports_stream", False))
+        self._pipe_active = False
+        self._pipe_adj = np.zeros(max_servants, np.int64)
+        self._pipe_resets: Dict[int, int] = {}
+        self._pipe_reset_barrier = np.full(max_servants, -1, np.int64)
+        self._pipe_launch_seq = 0
+
         self._thread: Optional[threading.Thread] = None
         if start_dispatch_thread:
             self._thread = threading.Thread(
-                target=self._dispatch_loop, name="dispatch", daemon=True
+                target=(self._pipelined_loop if self._pipelined
+                        else self._dispatch_loop),
+                name="dispatch", daemon=True,
             )
             self._thread.start()
 
@@ -427,60 +453,10 @@ class TaskDispatcher:
         with self._lock:
             now = self._clock.now()
             for (req, is_prefetch), pick in zip(work, picks):
-                if pick == NO_PICK or req.abandoned:
-                    continue
-                servant = self._slots[pick] if pick < len(self._slots) else None
-                if servant is None:
-                    continue  # died between snapshot and apply
-                # Re-validate at apply time; the snapshot may be stale.
-                # A slot recycled to a different machine while the
-                # policy ran unlocked invalidates the whole scoring
-                # decision (envs, version gate, self-avoidance were all
-                # judged against the OLD occupant) — the generation
-                # check rejects it wholesale.  Capacity is re-checked
-                # because other grants may have applied meanwhile.
-                if self._slot_generation[pick] != snap_generation[pick]:
-                    continue
-                # Capacity re-check, split into a per-cycle static part
-                # (gate flags + reported numbers, cached — ~512 grants
-                # per cycle often land on far fewer slots) and the
-                # running-count-dependent arithmetic which must track
-                # every grant applied in THIS loop.  Semantics identical
-                # to _effective_capacity_locked.
-                static = cap_cache.get(pick, False)
-                if static is False:
-                    info = servant.info
-                    static = cap_cache[pick] = (
-                        (info.capacity, info.num_processors,
-                         info.current_load)
-                        if info.not_accepting_reason == 0
-                        and info.memory_available >= self._min_memory
-                        else None)
-                if static is None:
-                    continue
-                cap, nprocs, load = static
-                n_running = len(servant.running_grants)
-                if n_running >= min(cap, nprocs - max(0, load - n_running)):
-                    continue
-                g = _Grant(
-                    grant_id=self._next_grant_id,
-                    slot=pick,
-                    servant_location=servant.info.location,
-                    env_digest=req.env_digest,
-                    expires_at=now + req.lease_s,
-                    requestor=req.requestor,
-                )
-                self._next_grant_id += 1
-                self._grants[g.grant_id] = g
-                servant.running_grants.add(g.grant_id)
-                self._arr_running[pick] += 1
-                req.grants.append(g)
-                if is_prefetch:
-                    req.prefetch_left -= 1
-                else:
-                    req.immediate_left -= 1
-                issued += 1
-                self._stats["granted"] += 1
+                if self._try_issue_locked(req, is_prefetch, int(pick),
+                                          snap_generation, cap_cache,
+                                          now):
+                    issued += 1
             # Prefetch never waits — but only for requests that actually
             # participated in this cycle; one that arrived mid-assign
             # keeps its prefetch for the next cycle.
@@ -490,6 +466,265 @@ class TaskDispatcher:
                     req.first_cycle_done = True
                     req.prefetch_left = 0
             self._finish_satisfied_locked(self._clock.now())
+        return issued
+
+    def _try_issue_locked(self, req, is_prefetch: bool, pick: int,
+                          snap_generation, cap_cache, now: float,
+                          ) -> Optional[bool]:
+        """Validate one policy pick against CURRENT state and issue the
+        grant.  Returns True = issued, False = rejected (the pick was a
+        real slot but state moved), None = nothing to do (NO_PICK).
+        Shared by the sync apply phase and the pipelined drain — the
+        validation semantics must be one definition."""
+        if pick == NO_PICK:
+            return None
+        if req.abandoned:
+            return False
+        servant = self._slots[pick] if pick < len(self._slots) else None
+        if servant is None:
+            return False  # died between snapshot and apply
+        # Re-validate at apply time; the snapshot may be stale.  A slot
+        # recycled to a different machine while the policy ran unlocked
+        # invalidates the whole scoring decision (envs, version gate,
+        # self-avoidance were all judged against the OLD occupant) —
+        # the generation check rejects it wholesale.  Capacity is
+        # re-checked because other grants may have applied meanwhile.
+        if self._slot_generation[pick] != snap_generation[pick]:
+            return False
+        # Capacity re-check, split into a per-cycle static part (gate
+        # flags + reported numbers, cached — ~512 grants per cycle
+        # often land on far fewer slots) and the running-count-dependent
+        # arithmetic which must track every grant applied in THIS
+        # cycle.  Semantics identical to _effective_capacity_locked.
+        static = cap_cache.get(pick, False)
+        if static is False:
+            info = servant.info
+            static = cap_cache[pick] = (
+                (info.capacity, info.num_processors, info.current_load)
+                if info.not_accepting_reason == 0
+                and info.memory_available >= self._min_memory
+                else None)
+        if static is None:
+            return False
+        cap, nprocs, load = static
+        n_running = len(servant.running_grants)
+        if n_running >= min(cap, nprocs - max(0, load - n_running)):
+            return False
+        g = _Grant(
+            grant_id=self._next_grant_id,
+            slot=pick,
+            servant_location=servant.info.location,
+            env_digest=req.env_digest,
+            expires_at=now + req.lease_s,
+            requestor=req.requestor,
+        )
+        self._next_grant_id += 1
+        self._grants[g.grant_id] = g
+        servant.running_grants.add(g.grant_id)
+        self._arr_running[pick] += 1
+        req.grants.append(g)
+        if is_prefetch:
+            req.prefetch_left -= 1
+        else:
+            req.immediate_left -= 1
+        self._stats["granted"] += 1
+        return True
+
+    # ------------------------------------------------------------------
+    # The pipelined dispatch loop (device-resident running chain).
+    #
+    # The sync loop above blocks inside policy.assign() for the full
+    # host->device->host round-trip every cycle; fine when the device
+    # sits on the host's PCIe, fatal when it is tens of ms away.  Here
+    # each cycle LAUNCHES without waiting (the policy chains `running`
+    # on device) and the picks of completed launches are applied as
+    # their async D2H copies land, up to `pipeline_depth` in flight.
+    # Host-side mutations between launches ride the next launch as a
+    # delta upload (see policy.JaxGroupedPolicy stream_* docs).
+    # ------------------------------------------------------------------
+
+    def _pipelined_loop(self) -> None:
+        import collections
+
+        policy = self._policy
+        tickets: "collections.deque" = collections.deque()
+        chain_ok = False     # device running chain seeded and trusted
+        failures = 0
+        while True:
+            launch = None
+            try:
+                if not chain_ok:
+                    # (Re)seed the chain from host truth — at startup,
+                    # and after any device error.  Failures here retry
+                    # through the same except path; granting must never
+                    # die silently with the thread.
+                    with self._lock:
+                        if self._stopping:
+                            break
+                        snap = self._snapshot_locked()
+                        self._pipe_active = True
+                        self._pipe_adj[:] = 0
+                        self._pipe_resets.clear()
+                    policy.stream_begin(snap)
+                    chain_ok = True
+                # Apply whatever has landed; never hold more than
+                # depth.  Drain BEFORE popping: a failed drain must
+                # stay in the deque so the error rollback sees it.
+                while tickets and (
+                        len(tickets) > self._pipeline_depth
+                        or policy.stream_ready(tickets[0][0])):
+                    self._drain_ticket(*tickets[0])
+                    tickets.popleft()
+                with self._lock:
+                    if self._stopping:
+                        break
+                    launch = self._select_stream_work_locked()
+                    if launch is None and not tickets:
+                        self._work.wait(timeout=0.1)
+                        continue
+                if launch is None:
+                    # Nothing new to launch: finish the oldest in-flight
+                    # launch so its waiters wake (blocking here costs
+                    # one RTT and there is nothing else to do).
+                    self._drain_ticket(*tickets[0])
+                    tickets.popleft()
+                    continue
+                work, descr, snap, gen, adj, resets, lid = launch
+                ticket = policy.stream_launch(snap, descr, adj, resets)
+                launch = None          # appended below: rollback claim ends
+                tickets.append((ticket, work, gen, lid))
+                failures = 0
+            except Exception:
+                # A device error mid-stream poisons the running chain:
+                # drop in-flight launches (their waiters retry on their
+                # own deadlines or the next cycle), mark the chain for
+                # reseeding, and keep serving.
+                logger.exception(
+                    "pipelined dispatch cycle failed; resyncing stream")
+                with self._lock:
+                    rollbacks = [w for _, w, _, _ in tickets]
+                    if launch is not None:   # the launch itself failed
+                        rollbacks.append(launch[0])
+                    for work in rollbacks:
+                        for req, is_prefetch in work:
+                            if is_prefetch:
+                                req.inflight_pre -= 1
+                                # The prefetch never happened; let the
+                                # next launch carry it again.
+                                req.prefetch_launched = False
+                            else:
+                                req.inflight_imm -= 1
+                    tickets.clear()
+                chain_ok = False
+                failures += 1
+                REAL_CLOCK.sleep(min(0.05 * failures, 1.0))
+        # Shutdown: drain what's left so accounting stays consistent
+        # for anyone inspecting state after stop().
+        while tickets:
+            try:
+                self._drain_ticket(*tickets[0])
+            except Exception:
+                break
+            finally:
+                tickets.popleft()
+
+    def _select_stream_work_locked(self):
+        """Pick the next launch's work under the chunk caps (at most
+        max_groups descriptor runs, at most _TASK_CAP entries — the
+        policy's warmed shape ladder).  Entries already in flight are
+        excluded; prefetch is all-or-nothing (it is opportunistic and
+        must never outlive the first cycle)."""
+        now = self._clock.now()
+        self._expire_pending_locked(now)
+        max_groups = getattr(self._policy, "_max_groups", 64)
+        task_cap = getattr(self._policy, "_TASK_CAP", 2048)
+        work: List[Tuple[_Pending, bool]] = []
+        descr: List[List[int]] = []
+
+        def emit(req, is_prefetch: bool, n: int) -> int:
+            """Append up to n entries of req; returns how many fit."""
+            key = (req.env_id, req.min_version, req.requestor_slot)
+            taken = 0
+            while n > 0 and len(work) < task_cap:
+                if not (descr and (descr[-1][0], descr[-1][1],
+                                   descr[-1][2]) == key):
+                    if len(descr) >= max_groups:
+                        break
+                    descr.append([key[0], key[1], key[2], 0])
+                t = min(n, task_cap - len(work))
+                descr[-1][3] += t
+                work.extend([(req, is_prefetch)] * t)
+                taken += t
+                n -= t
+            return taken
+
+        for req in self._pending:
+            n_imm = max(0, req.immediate_left - req.inflight_imm)
+            req.inflight_imm += emit(req, False, n_imm)
+            if (not req.prefetch_launched and not req.first_cycle_done
+                    and req.prefetch_left > 0
+                    and len(work) + req.prefetch_left <= task_cap
+                    and len(descr) < max_groups):
+                took = emit(req, True, req.prefetch_left)
+                if took == req.prefetch_left:
+                    req.inflight_pre += took
+                    req.prefetch_launched = True
+                else:   # didn't all fit: roll back, skip prefetch
+                    del work[len(work) - took:]
+                    descr[-1][3] -= took
+                    if descr[-1][3] == 0:
+                        descr.pop()
+            if len(work) >= task_cap:
+                break
+        if not work:
+            return None
+        snap = self._snapshot_locked()
+        gen = self._slot_generation.copy()
+        adj = self._pipe_adj.copy()
+        self._pipe_adj[:] = 0
+        resets = dict(self._pipe_resets)
+        self._pipe_resets.clear()
+        lid = self._pipe_launch_seq
+        self._pipe_launch_seq += 1
+        for slot in resets:
+            self._pipe_reset_barrier[slot] = lid
+        return (work, [tuple(d) for d in descr], snap, gen, adj,
+                resets, lid)
+
+    def _drain_ticket(self, ticket, work, snap_generation, lid) -> int:
+        """Apply one completed launch: validate each pick against
+        current state, issue grants, and convert host rejections into
+        running-chain corrections for the next launch."""
+        picks = self._policy.stream_collect(ticket)
+        issued = 0
+        cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
+        with self._lock:
+            now = self._clock.now()
+            for (req, is_prefetch), pick in zip(work, picks):
+                if is_prefetch:
+                    req.inflight_pre -= 1
+                else:
+                    req.inflight_imm -= 1
+                ok = self._try_issue_locked(req, is_prefetch, int(pick),
+                                            snap_generation, cap_cache,
+                                            now)
+                if ok:
+                    issued += 1
+                elif ok is False and int(pick) != NO_PICK:
+                    # The device counted this grant in its chain; the
+                    # host refused it.  Correct the chain — unless a
+                    # LATER launch already reset this slot absolutely
+                    # (the reset erased the phantom grant with
+                    # everything else).
+                    if self._pipe_reset_barrier[int(pick)] <= lid:
+                        self._pipe_adj[int(pick)] -= 1
+            participated = {id(r) for r, _ in work}
+            for req in self._pending:
+                if id(req) in participated:
+                    req.first_cycle_done = True
+                    req.prefetch_left = 0
+            self._finish_satisfied_locked(self._clock.now())
+            self._work.notify_all()
         return issued
 
     # ------------------------------------------------------------------
@@ -629,6 +864,13 @@ class TaskDispatcher:
         self._slots[slot] = None
         self._free_slots.append(slot)
         self._refresh_slot_arrays_locked(slot)
+        if self._pipe_active:
+            # Slot identity changed: the device value is garbage for
+            # any future occupant.  Overwrite absolutely on the next
+            # launch and void pending per-grant corrections (the reset
+            # subsumes them).
+            self._pipe_resets[slot] = 0
+            self._pipe_adj[slot] = 0
 
     def _release_grant_locked(self, g: _Grant) -> None:
         self._grants.pop(g.grant_id, None)
@@ -637,6 +879,11 @@ class TaskDispatcher:
             if g.grant_id in servant.running_grants:
                 servant.running_grants.discard(g.grant_id)
                 self._arr_running[g.slot] -= 1
+                if self._pipe_active:
+                    # The device running chain counted this grant (it
+                    # was issued through a drained launch); stream the
+                    # free to the device with the next launch.
+                    self._pipe_adj[g.slot] -= 1
 
     # ------------------------------------------------------------------
 
