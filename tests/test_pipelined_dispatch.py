@@ -266,3 +266,46 @@ class TestPipelinedErrorRecovery:
                     assert r.inflight_imm == 0 and r.inflight_pre == 0
         finally:
             d.stop()
+
+
+class TestAutoPolicyStreams:
+    def test_auto_policy_delegates_stream(self):
+        from yadcc_tpu.scheduler.policy import AutoPolicy
+
+        policy = AutoPolicy()
+        d = make_dispatcher(4, n_servants=6, capacity=2, policy=policy)
+        try:
+            grants = d.wait_for_starting_new_task(
+                "envA", immediate=8, timeout_s=10.0)
+            assert len(grants) == 8
+            drain_idle(d, policy._grouped)
+            chain_invariant(d, policy._grouped)
+        finally:
+            d.stop()
+
+
+class TestPermanentDeviceDeathFallback:
+    def test_degrades_to_sync_greedy_after_persistent_failures(self):
+        """The default policy (auto) in pipelined mode must not stall
+        forever on a dead device: after repeated failures the loop
+        pins the host fallback and hands over to the sync loop."""
+        from yadcc_tpu.scheduler.policy import AutoPolicy
+
+        class DeadDevicePolicy(AutoPolicy):
+            def stream_begin(self, snap):
+                raise RuntimeError("device permanently dead")
+
+            def stream_launch(self, *a, **kw):   # pragma: no cover
+                raise RuntimeError("device permanently dead")
+
+        policy = DeadDevicePolicy()
+        d = make_dispatcher(4, n_servants=4, capacity=2, policy=policy)
+        try:
+            # 8 failures x ~0.05-0.4s backoff, then sync greedy serves.
+            got = d.wait_for_starting_new_task(
+                "envA", immediate=4, timeout_s=20.0)
+            assert len(got) == 4
+            assert policy._device_dead
+            assert not d._pipelined
+        finally:
+            d.stop()

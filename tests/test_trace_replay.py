@@ -33,3 +33,18 @@ class TestTraceReplay:
         total = sum(len(e["requests"]) for e in events
                     if e["kind"] == "batch")
         assert total == 60
+
+    def test_stream_replay_matches_serialized(self, tmp_path):
+        """Pipelined stream replay must be outcome-identical to the
+        serialized run at every depth (the safety claim that lets the
+        dispatcher enable pipelining purely for throughput)."""
+        path = str(tmp_path / "t.jsonl")
+        trace_replay.generate_trace(path, tasks=300, servants=16,
+                                    batch=30, envs=4, seed=3)
+        results = trace_replay.replay_stream(path, depths=(0, 4, 16),
+                                             horizon=16)
+        assert results["stream_serialized"]["granted"] > 0
+        for key, r in results.items():
+            assert r["matches_serialized"], key
+        finals = {r["final_running"] for r in results.values()}
+        assert len(finals) == 1

@@ -101,6 +101,24 @@ class DispatchPolicy:
         first real grant cycle never pays a jit compile."""
 
 
+def compress_runs(requests: Sequence[AssignRequest]):
+    """Consecutive identical descriptors -> [(env_id, min_version,
+    requestor_slot, count)] runs, in request order.  THE descriptor
+    contract for grouped kernels and stream_launch: flat pick position
+    i always corresponds to request i.  Keep every producer on this
+    one definition (JaxGroupedPolicy.assign tracks member indices and
+    the dispatcher's launch selector interleaves chunk caps, but both
+    mirror this shape)."""
+    descr = []
+    for r in requests:
+        key = (r.env_id, r.min_version, r.requestor_slot)
+        if descr and tuple(descr[-1][:3]) == key:
+            descr[-1][3] += 1
+        else:
+            descr.append([key[0], key[1], key[2], 1])
+    return [tuple(d) for d in descr]
+
+
 @dataclass
 class StreamTicket:
     """Handle for one in-flight pipelined launch: the device picks
@@ -667,6 +685,28 @@ class AutoPolicy(DispatchPolicy):
 
     def warmup(self, pool_size: int, env_words: int = 8) -> None:
         self._grouped.warmup(pool_size, env_words)
+
+    # In pipelined mode every launch goes through the grouped device
+    # kernel — the greedy host shortcut only exists to dodge the device
+    # round-trip, and the stream never blocks on one.  Delegate the
+    # whole stream API so `--dispatch-policy auto` (the default) gets
+    # pipelining wherever the dispatcher enables it.
+    supports_stream = True
+
+    def stream_begin(self, snap):
+        return self._grouped.stream_begin(snap)
+
+    def stream_warmup(self, pool_size: int, env_words: int = 8) -> None:
+        self._grouped.stream_warmup(pool_size, env_words)
+
+    def stream_launch(self, snap, descr, adj, reset_slots):
+        return self._grouped.stream_launch(snap, descr, adj, reset_slots)
+
+    def stream_ready(self, ticket) -> bool:
+        return self._grouped.stream_ready(ticket)
+
+    def stream_collect(self, ticket):
+        return self._grouped.stream_collect(ticket)
 
     def _use_greedy(self, snap, n: int) -> bool:
         if self._threshold is not None:

@@ -617,6 +617,22 @@ class TaskDispatcher:
                     tickets.clear()
                 chain_ok = False
                 failures += 1
+                if failures >= 8:
+                    # The device is not coming back.  Pin the policy's
+                    # host fallback (AutoPolicy degrades to the greedy
+                    # oracle) and hand over to the synchronous loop —
+                    # grants must keep flowing at host speed, not stall
+                    # behind an eternal reseed-retry.
+                    logger.error(
+                        "pipelined dispatch failed %d times; degrading "
+                        "to synchronous dispatch", failures)
+                    if hasattr(self._policy, "_device_dead"):
+                        self._policy._device_dead = True
+                    with self._lock:
+                        self._pipe_active = False
+                        self._pipelined = False
+                    self._dispatch_loop()
+                    return
                 REAL_CLOCK.sleep(min(0.05 * failures, 1.0))
         # Shutdown: drain what's left so accounting stays consistent
         # for anyone inspecting state after stop().

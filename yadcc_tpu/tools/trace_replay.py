@@ -33,6 +33,7 @@ import numpy as np
 
 from ..scheduler.policy import (
     AssignRequest,
+    compress_runs,
     GreedyCpuPolicy,
     JaxBatchedPolicy,
     JaxGroupedPolicy,
@@ -199,6 +200,110 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
     return results
 
 
+def replay_stream(path: str, depths=(0, 16), horizon: int = 16) -> dict:
+    """Replay the trace through the PIPELINED policy stream and prove
+    outcome equivalence against the serialized run.
+
+    Free events are interpreted architecture-faithfully: the host
+    frees grants it has already collected (a task cannot complete
+    before its grant was even delivered), restricted to grants from
+    batches at least `horizon` launches old.  With horizon >= depth the
+    free schedule is identical for EVERY pipeline depth, so the
+    serialized (depth 0) and deep-pipeline runs must produce
+    bit-identical pick streams — the invariant that makes pipelining
+    safe to enable: it changes throughput, never outcomes."""
+    import collections
+
+    from ..scheduler.policy import JaxGroupedPolicy
+
+    events = _load(path)
+    assert events and events[0]["kind"] == "pool"
+    snap0 = _snapshot_from_pool(events[0])
+    s = len(snap0.alive)
+
+    def run(depth: int):
+        policy = JaxGroupedPolicy()
+        policy.stream_warmup(s, env_words=snap0.env_bitmap.shape[1])
+        host_running = np.zeros(s, np.int32)
+        snap = PoolSnapshot(
+            alive=snap0.alive, capacity=snap0.capacity,
+            running=host_running, dedicated=snap0.dedicated,
+            version=snap0.version, env_bitmap=snap0.env_bitmap)
+        policy.stream_begin(snap)
+        adj = np.zeros(s, np.int64)
+        tickets = collections.deque()
+        # Grants by age: batch index -> [slots]; freeable once the
+        # batch is `horizon` behind.
+        live_by_batch: "collections.OrderedDict" = collections.OrderedDict()
+        outcomes = []
+        granted = 0
+        batch_idx = 0
+
+        def drain_one():
+            nonlocal granted
+            bi, reqs, ticket = tickets.popleft()
+            picks = [int(p) for p in
+                     policy.stream_collect(ticket)[:len(reqs)]]
+            grants = live_by_batch.setdefault(bi, [])
+            for p in picks:
+                if p >= 0:
+                    host_running[p] += 1
+                    grants.append(p)
+                    granted += 1
+            outcomes.append(_run_multisets(reqs, picks))
+
+        t0 = time.perf_counter()
+        for ev in events[1:]:
+            if ev["kind"] == "batch":
+                reqs = [AssignRequest(*r) for r in ev["requests"]]
+                ticket = policy.stream_launch(
+                    snap, compress_runs(reqs), adj, {})
+                adj[:] = 0
+                tickets.append((batch_idx, reqs, ticket))
+                batch_idx += 1
+                while len(tickets) > depth:
+                    drain_one()
+            elif ev["kind"] == "free":
+                # Everything freeable must be drained first — enforced
+                # structurally when depth <= horizon.
+                while tickets and tickets[0][0] <= batch_idx - horizon:
+                    drain_one()
+                freeable = []
+                for bi in list(live_by_batch):
+                    if bi <= batch_idx - horizon:
+                        freeable.extend(
+                            (bi, p) for p in live_by_batch[bi])
+                k = int(len(freeable) * ev["fraction"])
+                for bi, slot in freeable[:k]:
+                    live_by_batch[bi].remove(slot)
+                    host_running[slot] -= 1
+                    adj[slot] -= 1
+        while tickets:
+            drain_one()
+        elapsed = time.perf_counter() - t0
+        return outcomes, granted, elapsed, host_running.copy()
+
+    results = {}
+    ref = None
+    for depth in depths:
+        outcomes, granted, elapsed, final_running = run(depth)
+        key = f"stream_depth_{depth}" if depth else "stream_serialized"
+        results[key] = {
+            "granted": granted,
+            "seconds": round(elapsed, 4),
+            "assignments_per_sec": round(granted / elapsed, 1),
+            "final_running": int(final_running.sum()),
+        }
+        if ref is None:
+            ref = (outcomes, final_running.tolist())
+            results[key]["matches_serialized"] = True
+        else:
+            results[key]["matches_serialized"] = (
+                outcomes == ref[0]
+                and final_running.tolist() == ref[1])
+    return results
+
+
 def main() -> None:
     ap = argparse.ArgumentParser("ytpu-trace-replay")
     ap.add_argument("trace")
@@ -206,6 +311,8 @@ def main() -> None:
     ap.add_argument("--tasks", type=int, default=6000)
     ap.add_argument("--servants", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-stream", action="store_true",
+                    help="skip the pipelined-stream equivalence section")
     args = ap.parse_args()
     if args.generate:
         generate_trace(args.trace, tasks=args.tasks,
@@ -217,6 +324,8 @@ def main() -> None:
     from ..utils.device_guard import running_forced_cpu
 
     results = replay(args.trace)
+    if not args.no_stream:
+        results["pipelined"] = replay_stream(args.trace)
     results["_meta"] = {
         "device": str(jax.devices()[0]),
         "forced_cpu_fallback": running_forced_cpu(),
@@ -225,6 +334,11 @@ def main() -> None:
     if not all(r["matches_reference"] for r in results.values()
                if isinstance(r, dict) and "matches_reference" in r):
         raise SystemExit("POLICY DIVERGENCE: outcomes differ from reference")
+    if not all(r.get("matches_serialized", True)
+               for r in results.get("pipelined", {}).values()
+               if isinstance(r, dict)):
+        raise SystemExit(
+            "STREAM DIVERGENCE: pipelined outcomes differ from serialized")
 
 
 if __name__ == "__main__":
