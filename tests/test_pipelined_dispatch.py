@@ -309,3 +309,68 @@ class TestPermanentDeviceDeathFallback:
             assert not d._pipelined
         finally:
             d.stop()
+
+
+class TestShardedStream:
+    def test_sharded_stream_matches_local_stream(self):
+        """The pod-scale stream step (sharded kernel + sharded
+        expansion + chained running) must be bit-identical to the
+        single-device stream step over chained launches with
+        corrections and resets in play."""
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops import assignment as asn
+        from yadcc_tpu.ops import assignment_grouped as asg
+        from yadcc_tpu.parallel import mesh as pmesh
+
+        rng = np.random.default_rng(11)
+        s, e_words, t_max = 64, 8, 64
+        mesh = pmesh.make_mesh()
+        fn = pmesh.sharded_assign_grouped_picks_stream_fn(mesh, t_max)
+        statics = dict(
+            alive=jnp.asarray(rng.random(s) < 0.9),
+            capacity=jnp.asarray(rng.integers(1, 6, s).astype(np.int32)),
+            dedicated=jnp.asarray(rng.random(s) < 0.3),
+            version=jnp.asarray(np.ones(s, np.int32)),
+            env_bitmap=jnp.asarray(rng.integers(
+                0, 2**32, (s, e_words), dtype=np.uint64).astype(np.uint32)),
+        )
+        run_l = jnp.zeros(s, jnp.int32)
+        run_s = jnp.zeros(s, jnp.int32)
+        for step in range(4):
+            groups = [(int(e), 1, -1, int(m)) for e, m in
+                      zip(rng.integers(0, 256, 3),
+                          rng.integers(1, 20, 3))]
+            packed = asg.make_grouped_packed(groups, pad_to=4)
+            adj = rng.integers(-1, 2, s).astype(np.int32)
+            rmask = (rng.random(s) < 0.05)
+            rval = rng.integers(0, 2, s).astype(np.int32)
+            p_l, run_l = asg.assign_grouped_picks_stream(
+                asn.PoolArrays(running=run_l, **statics), packed,
+                jnp.asarray(adj), jnp.asarray(rmask),
+                jnp.asarray(rval), t_max)
+            p_s, run_s = fn(
+                asn.PoolArrays(running=run_s, **statics), packed,
+                jnp.asarray(adj), jnp.asarray(rmask),
+                jnp.asarray(rval))
+            assert np.array_equal(np.asarray(p_l), np.asarray(p_s)), step
+            assert np.array_equal(np.asarray(run_l),
+                                  np.asarray(run_s)), step
+
+    def test_sharded_policy_pipelined_dispatch(self):
+        from yadcc_tpu.scheduler.policy import JaxShardedGroupedPolicy
+
+        policy = JaxShardedGroupedPolicy(max_groups=8)
+        d = make_dispatcher(4, n_servants=6, capacity=2, policy=policy)
+        try:
+            grants = d.wait_for_starting_new_task(
+                "envA", immediate=8, timeout_s=15.0)
+            assert len(grants) == 8
+            d.free_task([gid for gid, _ in grants])
+            grants = d.wait_for_starting_new_task(
+                "envA", immediate=8, timeout_s=15.0)
+            assert len(grants) == 8
+            drain_idle(d, policy)
+            chain_invariant(d, policy)
+        finally:
+            d.stop()

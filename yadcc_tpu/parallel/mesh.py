@@ -185,6 +185,57 @@ def sharded_assign_fn(mesh: Mesh,
 sharded_assign_fn_2d = sharded_assign_fn
 
 
+def _make_sharded_group_step(pool: PoolArrays, base, axes, cm, n_dev,
+                             linear):
+    """THE sharded restatement of assignment_grouped._group_counts —
+    one definition shared by the sync kernel and the stream kernel, so
+    a cost-model or tie-break change can't silently fork them.
+    Returns the scan body (running, group) -> (running, counts)."""
+    from ..ops.assignment_grouped import (_SEARCH_ITERS, make_count_leq,
+                                          search_bounds)
+
+    s_local = pool.alive.shape[0]
+
+    def group_step(running, group):
+        env_id, min_version, requestor, m = group
+        local_req = jnp.where(
+            (requestor >= base) & (requestor < base + s_local),
+            requestor - base, jnp.int32(-1))
+        count_leq = make_count_leq(pool, running, env_id,
+                                   min_version, local_req, cm)
+        lo, hi = search_bounds(cm)
+
+        def bisect(state, _):
+            lo, hi = state
+            mid = (lo + hi) // 2
+            total = jax.lax.psum(count_leq(mid).sum(), axes)
+            lo = jnp.where(total >= m, lo, mid)
+            hi = jnp.where(total >= m, mid, hi)
+            return (lo, hi), None
+
+        (lo, hi), _ = jax.lax.scan(
+            bisect, (jnp.int32(lo), hi), None, length=_SEARCH_ITERS)
+        tau = hi
+
+        below = count_leq(tau - 1)
+        at = count_leq(tau) - below
+        need_at = m - jax.lax.psum(below.sum(), axes)
+        # Exclusive prefix of per-device tie counts in linear device
+        # order: scatter my total into a device-indexed vector, psum
+        # it, then sum entries before mine.
+        at_total = at.sum()
+        vec = jnp.zeros(n_dev, jnp.int32).at[linear].set(at_total)
+        vec = jax.lax.psum(vec, axes)
+        dev_prefix = jnp.where(jnp.arange(n_dev) < linear,
+                               vec, 0).sum()
+        cum_before = dev_prefix + jnp.cumsum(at) - at
+        take_at = jnp.clip(need_at - cum_before, 0, at)
+        counts = (below + take_at).astype(jnp.int32)
+        return running + counts, counts
+
+    return group_step
+
+
 def sharded_assign_grouped_fn(
         mesh: Mesh, cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
     """Pod-scale variant of the flagship grouped kernel
@@ -200,8 +251,7 @@ def sharded_assign_grouped_fn(
     device order via an exclusive prefix of per-device tie counts
     (computed with one psum of a device-indexed one-hot, no gather
     ordering assumptions)."""
-    from ..ops.assignment_grouped import (_SEARCH_ITERS, GroupedBatch,
-                                          make_count_leq, search_bounds)
+    from ..ops.assignment_grouped import GroupedBatch
 
     axes = tuple(mesh.axis_names)
     cm = cost_model
@@ -211,47 +261,9 @@ def sharded_assign_grouped_fn(
         s_local = pool.alive.shape[0]
         linear = device_linear_index(mesh, axes)
         base = linear * s_local
-
-        def group_step(running, group):
-            env_id, min_version, requestor, m = group
-            local_req = jnp.where(
-                (requestor >= base) & (requestor < base + s_local),
-                requestor - base, jnp.int32(-1))
-            count_leq = make_count_leq(pool, running, env_id,
-                                       min_version, local_req, cm)
-            lo, hi = search_bounds(cm)
-
-            def bisect(state, _):
-                lo, hi = state
-                mid = (lo + hi) // 2
-                total = jax.lax.psum(count_leq(mid).sum(), axes)
-                lo = jnp.where(total >= m, lo, mid)
-                hi = jnp.where(total >= m, mid, hi)
-                return (lo, hi), None
-
-            (lo, hi), _ = jax.lax.scan(
-                bisect, (jnp.int32(lo), hi), None,
-                length=_SEARCH_ITERS)
-            tau = hi
-
-            below = count_leq(tau - 1)
-            at = count_leq(tau) - below
-            need_at = m - jax.lax.psum(below.sum(), axes)
-            # Exclusive prefix of per-device tie counts in linear
-            # device order: scatter my total into a device-indexed
-            # vector, psum it, then sum entries before mine.
-            at_total = at.sum()
-            vec = jnp.zeros(n_dev, jnp.int32).at[linear].set(at_total)
-            vec = jax.lax.psum(vec, axes)
-            dev_prefix = jnp.where(jnp.arange(n_dev) < linear,
-                                   vec, 0).sum()
-            cum_before = dev_prefix + jnp.cumsum(at) - at
-            take_at = jnp.clip(need_at - cum_before, 0, at)
-            counts = (below + take_at).astype(jnp.int32)
-            return running + counts, counts
-
         running, counts = jax.lax.scan(
-            group_step,
+            _make_sharded_group_step(pool, base, axes, cm, n_dev,
+                                     linear),
             pool.running,
             (batch.env_id, batch.min_version, batch.requestor,
              batch.count),
@@ -266,6 +278,90 @@ def sharded_assign_grouped_fn(
         mesh=mesh,
         in_specs=(pool_spec, batch_spec),
         out_specs=(P(None, axes), P(axes)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def sharded_assign_grouped_picks_stream_fn(
+        mesh: Mesh, t_max: int,
+        cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
+    """Pod-scale PIPELINED dispatch step: the sharded grouped kernel
+    plus sharded on-device grant expansion, with the running chain kept
+    device-resident ACROSS launches (ops/assignment_grouped.py
+    assign_grouped_picks_stream is the single-device twin).
+
+    (pool, packed [4,G], adj [S], reset_mask [S], reset_val [S]) ->
+    (picks int32[t_max] replicated, running [S] sharded).
+
+    The host delta (adj/resets) is elementwise on the sharded running —
+    no collectives.  Expansion distributes by construction: position q
+    of group g lands on exactly one device (the one whose cumulative
+    count range contains q); every device computes candidates for its
+    own range and one pmin per mesh axis merges them.  Collective cost
+    per launch stays pool-size-independent: ~22 bisect psums + 2 tie
+    psums per group, plus one [t_max] pmin pair for the expansion."""
+    from ..ops.assignment_grouped import unpack_grouped
+
+    axes = tuple(mesh.axis_names)
+    cm = cost_model
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    big = jnp.int32(2**30)
+
+    def body(pool: PoolArrays, packed, adj, reset_mask, reset_val):
+        batch = unpack_grouped(packed)
+        s_local = pool.alive.shape[0]
+        linear = device_linear_index(mesh, axes)
+        base = linear * s_local
+        g_n = batch.count.shape[0]
+
+        running0 = jnp.where(reset_mask, reset_val,
+                             jnp.maximum(pool.running + adj, 0))
+        running, counts = jax.lax.scan(
+            _make_sharded_group_step(pool, base, axes, cm, n_dev,
+                                     linear),
+            running0,
+            (batch.env_id, batch.min_version, batch.requestor,
+             batch.count),
+        )
+
+        # Sharded expansion (local twin: assignment_grouped.
+        # expand_counts).  c_local[g, j] = grants in my slice up to
+        # local slot j; dev_prefix[g] = grants on devices before mine.
+        c_local = jnp.cumsum(counts, axis=1)            # [G, s_local]
+        local_tot = c_local[:, -1]                      # [G]
+        tot_vec = jnp.zeros((n_dev, g_n), jnp.int32
+                            ).at[linear].set(local_tot)
+        tot_vec = jax.lax.psum(tot_vec, axes)
+        dev_prefix = jnp.where(
+            jnp.arange(n_dev)[:, None] < linear, tot_vec, 0).sum(0)
+        global_tot = tot_vec.sum(0)                     # [G] replicated
+
+        sizes = batch.count
+        offs_incl = jnp.cumsum(sizes)
+        offs_excl = offs_incl - sizes
+        t_idx = jnp.arange(t_max, dtype=jnp.int32)
+        g_t = (offs_incl[None, :] <= t_idx[:, None]).sum(1)
+        in_batch = g_t < g_n
+        g_tc = jnp.clip(g_t, 0, g_n - 1)
+        q = t_idx - offs_excl[g_tc]
+        q_local = q - dev_prefix[g_tc]
+        c_rows = jnp.take(c_local, g_tc, axis=0)        # [t_max, s_local]
+        local_pick = (c_rows <= q_local[:, None]).sum(1).astype(jnp.int32)
+        mine = (q_local >= 0) & (q_local < local_tot[g_tc])
+        granted = in_batch & (q < global_tot[g_tc])
+        cand = jnp.where(granted & mine, base + local_pick, big)
+        for name in reversed(axes):
+            cand = jax.lax.pmin(cand, name)
+        picks = jnp.where(granted, cand, NO_PICK)
+        return picks, running
+
+    pool_spec = pool_partition_spec(axes)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pool_spec, P(), P(axes), P(axes), P(axes)),
+        out_specs=(P(), P(axes)),
         check_vma=False,
     )
     return jax.jit(fn)

@@ -334,6 +334,14 @@ class JaxGroupedPolicy(DispatchPolicy):
         self._stream_running = jnp.asarray(snap.running)
         self._stream_next_id = 0
 
+    def _prepare_warm_pool(self, pool):
+        """Hook: place the warmup pool EXACTLY like live launches place
+        theirs — jit keys its executable cache on input shardings, so a
+        warmup against differently-placed arrays compiles the wrong
+        executable and the first live launch stalls anyway.  Identity
+        here; the pod-scale subclass shards."""
+        return pool
+
     def stream_warmup(self, pool_size: int, env_words: int = 8) -> None:
         """Compile the stream kernel's (group pad, task pad) ladder —
         the pipelined twin of warmup(); entry points call it before
@@ -343,11 +351,14 @@ class JaxGroupedPolicy(DispatchPolicy):
         from ..ops import assignment_grouped as asg
 
         zeros = jnp.zeros(pool_size, jnp.int32)
-        pool = asn.PoolArrays(
+        pool = self._prepare_warm_pool(asn.PoolArrays(
             alive=jnp.zeros(pool_size, bool),
             capacity=zeros, running=zeros,
             dedicated=jnp.zeros(pool_size, bool), version=zeros,
-            env_bitmap=jnp.zeros((pool_size, env_words), jnp.uint32))
+            env_bitmap=jnp.zeros((pool_size, env_words), jnp.uint32)))
+        # adj/reset vectors stay uncommitted, exactly like live
+        # launches pass them (uncommitted inputs don't key the jit
+        # executable cache on placement).
         falses = jnp.zeros(pool_size, bool)
         pad = asg.group_pad(0)
         while True:
@@ -383,7 +394,11 @@ class JaxGroupedPolicy(DispatchPolicy):
 
         from ..ops import assignment_grouped as asg
 
-        pool = _upload_pool(snap, self._stream_running, self._pool_cache)
+        # _prepare_grouped_pool is the placement hook: epoch-cached
+        # device upload here, mesh-sharded placement in the pod-scale
+        # subclass.  The chained running passes through jnp.asarray /
+        # device_put as a no-op (already resident, already placed).
+        pool = self._prepare_grouped_pool(snap, self._stream_running)
         packed = asg.make_grouped_packed(
             descr, pad_to=asg.group_pad(len(descr)))
         s = snap.alive.shape[0]
@@ -585,8 +600,6 @@ class JaxShardedGroupedPolicy(JaxGroupedPolicy):
     TestShardedGroupedAssign."""
 
     name = "jax_sharded_grouped"
-    # The stream kernel is the local XLA one; no sharded stream yet.
-    supports_stream = False
 
     def __init__(self, max_groups: int = 64,
                  cost_model: DispatchCostModel = DEFAULT_COST_MODEL):
@@ -597,17 +610,76 @@ class JaxShardedGroupedPolicy(JaxGroupedPolicy):
         self._fn = pmesh.sharded_assign_grouped_fn(self._mesh, cost_model)
         self._shard = pmesh.shard_pool
         self._ndev = int(self._mesh.devices.size)
-        # The sharded kernel's counts live distributed over the mesh;
-        # expansion stays on the host until a sharded expand exists.
+        # Sync assign(): the sharded kernel's counts live distributed
+        # over the mesh, so sync expansion stays on the host.  The
+        # STREAM path has its own sharded expansion
+        # (mesh.sharded_assign_grouped_picks_stream_fn), one per t_max.
         self._expand_on_device = False
+        self._stream_fns: dict = {}
+
+    def _stream_fn(self, t_max: int):
+        fn = self._stream_fns.get(t_max)
+        if fn is None:
+            from ..parallel import mesh as pmesh
+
+            fn = pmesh.sharded_assign_grouped_picks_stream_fn(
+                self._mesh, t_max, self._cm)
+            self._stream_fns[t_max] = fn
+        return fn
+
+    def stream_begin(self, snap) -> None:
+        import jax
+
+        from ..parallel import mesh as pmesh
+
+        self._stream_running = jax.device_put(
+            snap.running, pmesh.pool_sharding(self._mesh).running)
+        self._stream_next_id = 0
+
+    def _run_stream_kernel(self, pool, packed, adj, rmask, rval,
+                           t_max: int):
+        return self._stream_fn(t_max)(pool, packed, adj, rmask, rval)
+
+    def _prepare_warm_pool(self, pool):
+        from ..parallel import mesh as pmesh
+
+        return pmesh.shard_pool(pool, self._mesh)
 
     def _prepare_grouped_pool(self, snap, running):
+        """Mesh-sharded pool placement with the statics epoch cache:
+        without it EVERY pipelined launch re-uploads and 8-way reshards
+        the full env bitmap between heartbeats — the per-cycle device
+        cost the stream path exists to remove."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel import mesh as pmesh
+
         s = snap.alive.shape[0]
         if s % self._ndev:
             raise ValueError(
                 f"pool size {s} must divide evenly over "
                 f"{self._ndev} devices (pad max_servants)")
-        return self._shard(_upload_pool(snap, running), self._mesh)
+        sh = pmesh.pool_sharding(self._mesh)
+        cache = self._pool_cache
+        if snap.epoch >= 0 and cache.epoch == snap.epoch:
+            alive, dedicated, version, env_bitmap = cache.statics
+        else:
+            alive = jax.device_put(snap.alive, sh.alive)
+            dedicated = jax.device_put(snap.dedicated, sh.dedicated)
+            version = jax.device_put(snap.version, sh.version)
+            env_bitmap = jax.device_put(snap.env_bitmap, sh.env_bitmap)
+            if snap.epoch >= 0:
+                cache.epoch = snap.epoch
+                cache.statics = (alive, dedicated, version, env_bitmap)
+        return asn.PoolArrays(
+            alive=alive,
+            capacity=jax.device_put(snap.capacity, sh.capacity),
+            running=jax.device_put(running, sh.running),
+            dedicated=dedicated,
+            version=version,
+            env_bitmap=env_bitmap,
+        )
 
     def _run_grouped_kernel(self, pool, batch):
         return self._fn(pool, batch)
