@@ -312,6 +312,16 @@ def assign_grouped_picks_packed(
                                 cost_model)
 
 
+def fold_stream_delta(running: jax.Array, adj: jax.Array,
+                      reset_mask: jax.Array,
+                      reset_val: jax.Array) -> jax.Array:
+    """THE host-correction fold for the pipelined running chain —
+    one definition shared by the XLA, Pallas, and mesh-sharded stream
+    steps (their chained outputs must stay bit-identical)."""
+    return jnp.where(reset_mask, reset_val,
+                     jnp.maximum(running + adj, 0))
+
+
 @functools.partial(jax.jit, static_argnames=("t_max", "cost_model"))
 def assign_grouped_picks_stream(
     pool: PoolArrays,
@@ -340,8 +350,7 @@ def assign_grouped_picks_stream(
     running + grants issued by still-in-flight launches.  One launch,
     one [4, G] + O(S) upload, one O(T) picks download — the dispatch
     cycle never blocks on device->host latency."""
-    running = jnp.where(reset_mask, reset_val,
-                        jnp.maximum(pool.running + adj, 0))
+    running = fold_stream_delta(pool.running, adj, reset_mask, reset_val)
     return assign_grouped_picks(pool._replace(running=running),
                                 unpack_grouped(packed), t_max, cost_model)
 

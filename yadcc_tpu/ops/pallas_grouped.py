@@ -245,3 +245,27 @@ def pallas_assign_grouped_picks_packed(
     return pallas_assign_grouped_picks(
         pool, unpack_grouped(packed), t_max, cost_model,
         interpret=interpret)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("t_max", "cost_model", "interpret"))
+def pallas_assign_grouped_picks_stream(
+    pool: PoolArrays,
+    packed: jax.Array,
+    adj: jax.Array,
+    reset_mask: jax.Array,
+    reset_val: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipelined stream step through the Pallas kernel: the host delta
+    fold and the expansion are XLA ops spliced around the pallas_call
+    in ONE executable (assignment_grouped.assign_grouped_picks_stream
+    is the pure-XLA twin; semantics must match bit-for-bit)."""
+    from .assignment_grouped import fold_stream_delta
+
+    running = fold_stream_delta(pool.running, adj, reset_mask, reset_val)
+    return pallas_assign_grouped_picks_packed(
+        pool._replace(running=running), packed, t_max, cost_model,
+        interpret=interpret)

@@ -301,7 +301,7 @@ def sharded_assign_grouped_picks_stream_fn(
     own range and one pmin per mesh axis merges them.  Collective cost
     per launch stays pool-size-independent: ~22 bisect psums + 2 tie
     psums per group, plus one [t_max] pmin pair for the expansion."""
-    from ..ops.assignment_grouped import unpack_grouped
+    from ..ops.assignment_grouped import fold_stream_delta, unpack_grouped
 
     axes = tuple(mesh.axis_names)
     cm = cost_model
@@ -315,8 +315,8 @@ def sharded_assign_grouped_picks_stream_fn(
         base = linear * s_local
         g_n = batch.count.shape[0]
 
-        running0 = jnp.where(reset_mask, reset_val,
-                             jnp.maximum(pool.running + adj, 0))
+        running0 = fold_stream_delta(pool.running, adj, reset_mask,
+                                     reset_val)
         running, counts = jax.lax.scan(
             _make_sharded_group_step(pool, base, axes, cm, n_dev,
                                      linear),

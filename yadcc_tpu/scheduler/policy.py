@@ -711,6 +711,17 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
         return pallas_assign_grouped_picks_packed(
             pool, packed, t_max, self._cm, interpret=interpret)
 
+    def _run_stream_kernel(self, pool, packed, adj, rmask, rval,
+                           t_max: int):
+        import jax
+
+        from ..ops.pallas_grouped import pallas_assign_grouped_picks_stream
+
+        interpret = jax.devices()[0].platform != "tpu"
+        return pallas_assign_grouped_picks_stream(
+            pool, packed, adj, rmask, rval, t_max, self._cm,
+            interpret=interpret)
+
 
 class JaxPallasPolicy(JaxBatchedPolicy):
     """assign_batch semantics via the single-pallas-call kernel
