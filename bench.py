@@ -268,9 +268,15 @@ def main() -> None:
     # incremental snapshot, policy kernel, lease bookkeeping, apply
     # phase — not just the raw kernel.  5000 live servants, 512-request
     # backlog per cycle (BASELINE "p99 @5k workers" scenario).
-    disp_per_sec = _dispatcher_cycle_throughput()
-    disp_pipe_per_sec = _dispatcher_pipelined_throughput()
-    beats_per_sec = _heartbeat_throughput()
+    # BENCH_SECTIONS=headline skips the (minutes-long) full-dispatcher
+    # and heartbeat sections — used by the pool-size sweep, where only
+    # the kernel-path scaling is under test.
+    headline_only = os.environ.get("BENCH_SECTIONS") == "headline"
+    disp_per_sec = None if headline_only \
+        else _dispatcher_cycle_throughput()
+    disp_pipe_per_sec = None if headline_only \
+        else _dispatcher_pipelined_throughput()
+    beats_per_sec = None if headline_only else _heartbeat_throughput()
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",

@@ -63,6 +63,27 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       && mv artifacts/bloom_bench_tpu.json.tmp \
            artifacts/bloom_bench_tpu.json \
       && echo "$(date -Is) bloom_bench_tpu.json captured" >> "$LOG"
+    # 4. Pool-size scaling sweep (headline section only): the design
+    #    thesis — device dispatch holds throughput as the fleet grows.
+    {
+      echo '{"sweep": ['
+      first=1
+      for S in 5120 20480 65536; do
+        line=$(timeout "$TOOL_TIMEOUT" env BENCH_CHILD=1 \
+          BENCH_SKIP_PALLAS=1 BENCH_SECTIONS=headline \
+          BENCH_BATCHES=100 BENCH_POOL="$S" python -u bench.py \
+          2>> "$LOG" | tail -1)
+        [ -n "$line" ] || continue
+        [ "$first" = 1 ] || echo ','
+        first=0
+        printf '%s' "$line"
+      done
+      echo '], "note": "assignments/s vs pool size, same batch mix"}'
+    } > artifacts/pool_sweep_tpu.json.tmp \
+      && grep -q '"device"' artifacts/pool_sweep_tpu.json.tmp \
+      && mv artifacts/pool_sweep_tpu.json.tmp \
+           artifacts/pool_sweep_tpu.json \
+      && echo "$(date -Is) pool_sweep_tpu.json captured" >> "$LOG"
     if [ -s artifacts/bench_tpu.json ]; then
       echo "$(date -Is) capture complete" >> "$LOG"
       exit 0
