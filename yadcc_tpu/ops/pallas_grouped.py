@@ -71,7 +71,7 @@ def _take_lowest_slots(at: jax.Array, need: jax.Array,
         jnp.where(slots == jstar, jnp.clip(rem, 0, at), 0))
 
 
-def _kernel_body(cm: DispatchCostModel):
+def _kernel_body(cm: DispatchCostModel, rows_per_block: int):
     # Plain Python ints: jnp scalars here would be captured as traced
     # constants, which pallas_call refuses.
     pref_thresh_q = int(cm.dedicated_preference_utilization_q)
@@ -94,6 +94,11 @@ def _kernel_body(cm: DispatchCostModel):
         @pl.when(g == 0)
         def _():
             running_scratch[:] = running_in_ref[:]
+
+        # First visit of each counts block (the whole array when
+        # rows_per_block == G; every 8 rows when tiled): zero it.
+        @pl.when(g % rows_per_block == 0)
+        def _():
             counts_ref[:, :] = jnp.zeros_like(counts_ref)
 
         running = running_scratch[:]
@@ -147,12 +152,13 @@ def _kernel_body(cm: DispatchCostModel):
 
         # Mosaic rejects sub-tile (1, S) row blocks on a (G, S) output
         # (last two block dims must be (8k, 128k) or the full array), so
-        # the output rides ONE full-array block revisited every step and
-        # the row lands via an iota select — a (G, S) vector op, cheap
-        # at dispatch sizes.
+        # the output rides a (rows_per_block, S) block revisited across
+        # steps and the row lands via an iota select — a vector op,
+        # cheap at dispatch sizes.  rows_per_block == G keeps the whole
+        # array VMEM-resident; 8-row tiles bound VMEM at G*S scale.
         row = jax.lax.broadcasted_iota(jnp.int32, counts_ref.shape, 0)
-        counts_ref[:, :] = jnp.where(row == g, counts[None, :],
-                                     counts_ref[:, :])
+        counts_ref[:, :] = jnp.where(row == g % rows_per_block,
+                                     counts[None, :], counts_ref[:, :])
         running_scratch[:] = running + counts
 
         @pl.when(g == pl.num_programs(0) - 1)
@@ -160,6 +166,34 @@ def _kernel_body(cm: DispatchCostModel):
             running_out_ref[:] = running_scratch[:]
 
     return kernel
+
+
+# VMEM ceiling the kernel budgets against (v5e/v6e cores carry ~16MB;
+# leave headroom for Mosaic's own temporaries and double-buffering).
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+# Counts blocks beyond this ride 8-row tiles instead of one full-array
+# VMEM block (the full block is ~free at dispatch sizes but hits VMEM
+# OOM at pod scale: G=64 x S=65536 x int32 = 16MB on its own).
+_COUNTS_FULL_BLOCK_MAX = 2 * 1024 * 1024
+
+
+def _vmem_plan(g: int, s: int, e_words: int) -> int:
+    """Pick the counts rows_per_block and enforce the VMEM budget.
+    Raises ValueError (loudly, at trace time) instead of letting Mosaic
+    hit an opaque compile-time OOM; callers fall back to the XLA path
+    (assignment_grouped.assign_grouped) which tiles freely."""
+    rows = g if g * s * 4 <= _COUNTS_FULL_BLOCK_MAX or g % 8 else 8
+    fixed = (6 * s * 4          # pool arrays
+             + e_words * s * 4  # transposed env bitmap
+             + 2 * s * 4        # running_out + scratch
+             + rows * s * 4)    # counts block
+    if fixed > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"pallas_assign_grouped: VMEM plan {fixed} bytes exceeds "
+            f"budget {_VMEM_BUDGET_BYTES} (G={g}, S={s}, "
+            f"e_words={e_words}); use the XLA grouped kernel for this "
+            f"geometry")
+    return rows
 
 
 @functools.partial(jax.jit, static_argnames=("cost_model", "interpret"))
@@ -172,21 +206,23 @@ def pallas_assign_grouped(
     """Drop-in equivalent of assignment_grouped.assign_grouped."""
     s = pool.alive.shape[0]
     g = batch.env_id.shape[0]
+    rows_per_block = _vmem_plan(g, s, pool.env_bitmap.shape[1])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(g,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 6,
         out_specs=[
-            pl.BlockSpec((g, s), lambda i, *_: (0, 0),
-                         memory_space=pltpu.VMEM),  # counts (full block)
+            pl.BlockSpec((rows_per_block, s),
+                         lambda i, *_: (i // rows_per_block, 0),
+                         memory_space=pltpu.VMEM),  # counts
             pl.BlockSpec((s,), lambda i, *_: (0,),
                          memory_space=pltpu.VMEM),  # running_out
         ],
         scratch_shapes=[pltpu.VMEM((s,), jnp.int32)],
     )
     counts, running = pl.pallas_call(
-        _kernel_body(cost_model),
+        _kernel_body(cost_model, rows_per_block),
         out_shape=[
             jax.ShapeDtypeStruct((g, s), jnp.int32),
             jax.ShapeDtypeStruct((s,), jnp.int32),

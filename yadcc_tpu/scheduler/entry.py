@@ -109,6 +109,9 @@ def scheduler_start(args) -> None:
     # a live grant cycle for hundreds of ms.
     if depth > 0:
         policy.stream_warmup(args.max_servants)
+        # The sync assign() ladder must be warm too: it is the landing
+        # path if pipelining ever degrades mid-serving.
+        policy.warmup(args.max_servants)
     else:
         policy.warmup(args.max_servants)
     dispatcher = TaskDispatcher(

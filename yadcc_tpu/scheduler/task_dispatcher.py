@@ -524,7 +524,9 @@ class TaskDispatcher:
         self._arr_running[pick] += 1
         req.grants.append(g)
         if is_prefetch:
-            req.prefetch_left -= 1
+            # Clamped: a drained earlier ticket may already have zeroed
+            # prefetch_left while this entry was still in flight.
+            req.prefetch_left = max(0, req.prefetch_left - 1)
         else:
             req.immediate_left -= 1
         self._stats["granted"] += 1
@@ -550,6 +552,7 @@ class TaskDispatcher:
         tickets: "collections.deque" = collections.deque()
         chain_ok = False     # device running chain seeded and trusted
         failures = 0
+        starved = False      # last completed drain issued zero grants
         while True:
             launch = None
             try:
@@ -573,8 +576,20 @@ class TaskDispatcher:
                 while tickets and (
                         len(tickets) > self._pipeline_depth
                         or policy.stream_ready(tickets[0][0])):
-                    self._drain_ticket(*tickets[0])
+                    starved = self._drain_ticket(*tickets[0]) == 0
                     tickets.popleft()
+                if starved and not tickets:
+                    # The whole in-flight window produced zero grants
+                    # (every pick rejected or NO_PICK) — an unsatisfiable
+                    # backlog.  Relaunching immediately would burn an
+                    # O(S) snapshot plus a device launch per RTT until
+                    # deadlines expire; park like the sync loop until a
+                    # state change (heartbeat/free/queue) or a timeout.
+                    with self._lock:
+                        if self._stopping:
+                            break
+                        self._work.wait(timeout=0.25)
+                    starved = False
                 with self._lock:
                     if self._stopping:
                         break
@@ -586,7 +601,7 @@ class TaskDispatcher:
                     # Nothing new to launch: finish the oldest in-flight
                     # launch so its waiters wake (blocking here costs
                     # one RTT and there is nothing else to do).
-                    self._drain_ticket(*tickets[0])
+                    starved = self._drain_ticket(*tickets[0]) == 0
                     tickets.popleft()
                     continue
                 work, descr, snap, gen, adj, resets, lid = launch
@@ -628,6 +643,17 @@ class TaskDispatcher:
                         "to synchronous dispatch", failures)
                     if hasattr(self._policy, "_device_dead"):
                         self._policy._device_dead = True
+                    else:
+                        # Non-auto device policies have no host fallback:
+                        # handing them to the sync loop would keep
+                        # driving the same broken device.  Swap in the
+                        # greedy oracle — grants at host speed beat a
+                        # faithful stall.
+                        from .policy import GreedyCpuPolicy
+                        logger.error(
+                            "policy %s has no host fallback; swapping "
+                            "in greedy_cpu", self._policy.name)
+                        self._policy = GreedyCpuPolicy()
                     with self._lock:
                         self._pipe_active = False
                         self._pipelined = False
@@ -738,7 +764,11 @@ class TaskDispatcher:
             for req in self._pending:
                 if id(req) in participated:
                     req.first_cycle_done = True
-                    req.prefetch_left = 0
+                    # A LATER in-flight ticket may still carry this
+                    # request's prefetch entries; zeroing now would
+                    # drive prefetch_left negative when they land.
+                    if req.inflight_pre == 0:
+                        req.prefetch_left = 0
             self._finish_satisfied_locked(self._clock.now())
             self._work.notify_all()
         return issued
