@@ -141,3 +141,31 @@ def test_pod_geometry_has_a_vmem_plan():
         rows = pg._vmem_plan(64, s, 8)
         assert rows in (8, 64)
     assert pg._vmem_plan(64, 65536, 8) == 8
+
+
+def test_policy_falls_back_to_xla_over_budget(monkeypatch):
+    """Over-budget geometries must still serve: the pallas policy
+    routes them to the XLA grouped kernel instead of crashing."""
+    from yadcc_tpu.ops import pallas_grouped as pg
+    from yadcc_tpu.scheduler.policy import (AssignRequest,
+                                            JaxGroupedPolicy,
+                                            PoolSnapshot, make_policy)
+
+    monkeypatch.setattr(pg, "_VMEM_BUDGET_BYTES", 1024)
+    pol = make_policy("jax_pallas_grouped", max_servants=64)
+    rng = np.random.default_rng(9)
+    s = 64
+    snap = PoolSnapshot(
+        alive=np.ones(s, bool),
+        capacity=rng.integers(1, 8, s).astype(np.int32),
+        running=np.zeros(s, np.int32),
+        dedicated=rng.random(s) < 0.3,
+        version=np.ones(s, np.int32),
+        env_bitmap=np.full((s, 8), 0xFFFFFFFF, np.uint32),
+    )
+    import copy
+
+    reqs = [AssignRequest(2, 1, -1)] * 10
+    want = JaxGroupedPolicy().assign(copy.deepcopy(snap), reqs)
+    got = pol.assign(copy.deepcopy(snap), reqs)
+    assert got == want

@@ -161,8 +161,12 @@ def daemon_start(args) -> None:
     config.location = args.location or \
         f"{_guess_local_ip(args.scheduler_uri)}:{servant_server.port}"
     config_keeper = ConfigKeeper(args.scheduler_uri, args.token)
+    # PutEntry authenticates with the daemon's STATIC token (the cache
+    # server checks --acceptable-servant-tokens; reference
+    # distributed_cache_writer.cc:68 sends FLAGS_token) — NOT the
+    # rotating serving-daemon token, which the cache server never sees.
     cache_writer = DistributedCacheWriter(
-        args.cache_server_uri, config_keeper.serving_daemon_token)
+        args.cache_server_uri, lambda: args.token)
     service = DaemonService(
         config, engine=engine, registry=registry, cache_writer=cache_writer,
         sampler=sampler, allow_poor_machine=args.allow_poor_machine,

@@ -180,8 +180,9 @@ _COUNTS_FULL_BLOCK_MAX = 2 * 1024 * 1024
 def _vmem_plan(g: int, s: int, e_words: int) -> int:
     """Pick the counts rows_per_block and enforce the VMEM budget.
     Raises ValueError (loudly, at trace time) instead of letting Mosaic
-    hit an opaque compile-time OOM; callers fall back to the XLA path
-    (assignment_grouped.assign_grouped) which tiles freely."""
+    hit an opaque compile-time OOM.  JaxPallasGroupedPolicy pre-checks
+    this plan and routes over-budget geometries to the XLA grouped
+    kernel (assignment_grouped.assign_grouped), which tiles freely."""
     rows = g if g * s * 4 <= _COUNTS_FULL_BLOCK_MAX or g % 8 else 8
     fixed = (6 * s * 4          # pool arrays
              + e_words * s * 4  # transposed env bitmap

@@ -108,10 +108,10 @@ def scheduler_start(args) -> None:
     # BEFORE accepting requests: a mid-serving jit compile would stall
     # a live grant cycle for hundreds of ms.
     if depth > 0:
+        # Degradation lands on a HOST policy (AutoPolicy pins
+        # _device_dead; others are swapped for greedy_cpu), so the sync
+        # device ladder needs no warmup here.
         policy.stream_warmup(args.max_servants)
-        # The sync assign() ladder must be warm too: it is the landing
-        # path if pipelining ever degrades mid-serving.
-        policy.warmup(args.max_servants)
     else:
         policy.warmup(args.max_servants)
     dispatcher = TaskDispatcher(

@@ -693,11 +693,34 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
 
     name = "jax_pallas_grouped"
 
+    def _pallas_fits(self, g: int, s: int, e_words: int) -> bool:
+        """True when ops.pallas_grouped has a VMEM plan for this
+        geometry; otherwise log once and route to the XLA grouped
+        kernel (super()), which tiles freely."""
+        from ..ops.pallas_grouped import _vmem_plan
+
+        cache = self.__dict__.setdefault("_plan_cache", {})
+        key = (g, s, e_words)
+        if key not in cache:
+            try:
+                _vmem_plan(g, s, e_words)
+                cache[key] = True
+            except ValueError as e:
+                logger.warning(
+                    "pallas grouped kernel unavailable (%s); using the "
+                    "XLA grouped kernel for this geometry", e)
+                cache[key] = False
+        return cache[key]
+
     def _run_grouped_kernel(self, pool, batch):
         import jax
 
         from ..ops.pallas_grouped import pallas_assign_grouped
 
+        if not self._pallas_fits(batch.env_id.shape[0],
+                                 pool.alive.shape[0],
+                                 pool.env_bitmap.shape[1]):
+            return super()._run_grouped_kernel(pool, batch)
         interpret = jax.devices()[0].platform != "tpu"
         return pallas_assign_grouped(pool, batch, self._cm,
                                      interpret=interpret)
@@ -707,6 +730,9 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
 
         from ..ops.pallas_grouped import pallas_assign_grouped_picks_packed
 
+        if not self._pallas_fits(packed.shape[1], pool.alive.shape[0],
+                                 pool.env_bitmap.shape[1]):
+            return super()._run_picks_kernel(pool, packed, t_max)
         interpret = jax.devices()[0].platform != "tpu"
         return pallas_assign_grouped_picks_packed(
             pool, packed, t_max, self._cm, interpret=interpret)
@@ -717,6 +743,10 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
 
         from ..ops.pallas_grouped import pallas_assign_grouped_picks_stream
 
+        if not self._pallas_fits(packed.shape[1], pool.alive.shape[0],
+                                 pool.env_bitmap.shape[1]):
+            return super()._run_stream_kernel(pool, packed, adj, rmask,
+                                              rval, t_max)
         interpret = jax.devices()[0].platform != "tpu"
         return pallas_assign_grouped_picks_stream(
             pool, packed, adj, rmask, rval, t_max, self._cm,

@@ -552,7 +552,12 @@ class TaskDispatcher:
         tickets: "collections.deque" = collections.deque()
         chain_ok = False     # device running chain seeded and trusted
         failures = 0
-        starved = False      # last completed drain issued zero grants
+        # Grants issued / tickets drained since the in-flight window was
+        # last empty: the starvation park below must look at the WHOLE
+        # window, not just the last ticket (one racy zero-grant ticket
+        # after a productive one is not starvation).
+        window_issued = 0
+        window_drains = 0
         while True:
             launch = None
             try:
@@ -576,20 +581,24 @@ class TaskDispatcher:
                 while tickets and (
                         len(tickets) > self._pipeline_depth
                         or policy.stream_ready(tickets[0][0])):
-                    starved = self._drain_ticket(*tickets[0]) == 0
+                    window_issued += self._drain_ticket(*tickets[0])
+                    window_drains += 1
                     tickets.popleft()
-                if starved and not tickets:
-                    # The whole in-flight window produced zero grants
-                    # (every pick rejected or NO_PICK) — an unsatisfiable
-                    # backlog.  Relaunching immediately would burn an
-                    # O(S) snapshot plus a device launch per RTT until
-                    # deadlines expire; park like the sync loop until a
-                    # state change (heartbeat/free/queue) or a timeout.
-                    with self._lock:
-                        if self._stopping:
-                            break
-                        self._work.wait(timeout=0.25)
-                    starved = False
+                if not tickets and window_drains:
+                    if window_issued == 0:
+                        # The whole in-flight window produced zero
+                        # grants (every pick rejected or NO_PICK) — an
+                        # unsatisfiable backlog.  Relaunching
+                        # immediately would burn an O(S) snapshot plus
+                        # a device launch per RTT until deadlines
+                        # expire; park like the sync loop until a state
+                        # change (heartbeat/free/queue) or a timeout.
+                        with self._lock:
+                            if self._stopping:
+                                break
+                            self._work.wait(timeout=0.25)
+                    window_issued = 0
+                    window_drains = 0
                 with self._lock:
                     if self._stopping:
                         break
@@ -601,7 +610,8 @@ class TaskDispatcher:
                     # Nothing new to launch: finish the oldest in-flight
                     # launch so its waiters wake (blocking here costs
                     # one RTT and there is nothing else to do).
-                    starved = self._drain_ticket(*tickets[0]) == 0
+                    window_issued += self._drain_ticket(*tickets[0])
+                    window_drains += 1
                     tickets.popleft()
                     continue
                 work, descr, snap, gen, adj, resets, lid = launch
@@ -631,6 +641,8 @@ class TaskDispatcher:
                                 req.inflight_imm -= 1
                     tickets.clear()
                 chain_ok = False
+                window_issued = 0
+                window_drains = 0
                 failures += 1
                 if failures >= 8:
                     # The device is not coming back.  Pin the policy's
@@ -647,13 +659,17 @@ class TaskDispatcher:
                         # Non-auto device policies have no host fallback:
                         # handing them to the sync loop would keep
                         # driving the same broken device.  Swap in the
-                        # greedy oracle — grants at host speed beat a
-                        # faithful stall.
+                        # greedy oracle (keeping the configured cost
+                        # model) — grants at host speed beat a faithful
+                        # stall.
+                        from ..models.cost import DEFAULT_COST_MODEL
                         from .policy import GreedyCpuPolicy
                         logger.error(
                             "policy %s has no host fallback; swapping "
                             "in greedy_cpu", self._policy.name)
-                        self._policy = GreedyCpuPolicy()
+                        self._policy = GreedyCpuPolicy(
+                            getattr(self._policy, "_cm",
+                                    DEFAULT_COST_MODEL))
                     with self._lock:
                         self._pipe_active = False
                         self._pipelined = False

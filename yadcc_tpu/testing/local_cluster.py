@@ -99,8 +99,13 @@ class _Servant:
         self.engine = ExecutionEngine(max_concurrency=max_concurrency,
                                       min_memory_for_new_task=1)
         self.config_keeper = ConfigKeeper(cluster.sched_uri, "")
-        cache_writer = DistributedCacheWriter(
-            cluster.cache_uri, self.config_keeper.serving_daemon_token)
+        # Same wiring as daemon/entry.py: cache fills authenticate with
+        # the daemon's STATIC token (cache server checks
+        # --acceptable-servant-tokens), never the rotating
+        # serving-daemon token.  The rig's verifier accepts everything,
+        # so only matching production wiring keeps this tier honest.
+        cache_writer = DistributedCacheWriter(cluster.cache_uri,
+                                              lambda: "")
         # Synthetic machine: big enough to advertise `max_concurrency`
         # slots regardless of this host's real core count, and ALWAYS
         # idle — N rig servants share one real box, and each would
