@@ -335,3 +335,69 @@ class TestDaemonService:
         t2 = self._queue(ch, service, source=b"long" * 10,
                          args="-Dsleepy && sleep 1")
         assert t1 == t2  # joined, not recompiled
+
+
+class TestCompilerBundleDirs:
+    def test_bundle_scan(self, tmp_path, monkeypatch):
+        """--extra-compiler-bundle-dirs enumerates <bundle>/*/bin like
+        the reference (compiler_registry.cc:210-222): real compilers
+        register, wrapper symlinks are skipped, non-dir clutter is
+        ignored."""
+        bundle = tmp_path / "toolchains"
+        fake = TESTDATA / "toolchains" / "bin" / "g++"
+        # Two distinct toolchains (different bytes -> different digests).
+        for name, salt in (("gcc-10", "a"), ("clang-14", "b")):
+            b = bundle / name / "bin"
+            b.mkdir(parents=True)
+            target = b / ("g++" if name.startswith("gcc") else "clang")
+            target.write_bytes(fake.read_bytes() + f"# {salt}\n".encode())
+            target.chmod(0o755)
+        # A wrapper hiding inside a bundle must be skipped.
+        wrap = bundle / "wrapped" / "bin"
+        wrap.mkdir(parents=True)
+        (wrap / "ccache-real").write_bytes(b"#!/bin/sh\n")
+        (wrap / "ccache-real").chmod(0o755)
+        (wrap / "gcc").symlink_to(wrap / "ccache-real")
+        # Clutter: plain file at the bundle level, dir without bin/.
+        (bundle / "README").write_text("not a toolchain")
+        (bundle / "empty").mkdir()
+
+        monkeypatch.setenv("PATH", str(tmp_path / "nothing-here"))
+        # Hermetic: a RHEL host's real devtoolsets must not leak in.
+        from yadcc_tpu.daemon.cloud import compiler_registry as cr
+        monkeypatch.setattr(cr, "_DEVTOOLSET_FMT",
+                            str(tmp_path / "dts-{}"))
+        r = CompilerRegistry(bundle_dirs=[str(bundle)])
+        envs = r.environments()
+        assert len(envs) == 2
+        paths = sorted(r.try_get_compiler_path(e) for e in envs)
+        assert paths[0].endswith("clang-14/bin/clang")
+        assert paths[1].endswith("gcc-10/bin/g++")
+
+    def test_missing_bundle_dir_is_silent(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PATH", str(tmp_path / "nothing"))
+        from yadcc_tpu.daemon.cloud import compiler_registry as cr
+        monkeypatch.setattr(cr, "_DEVTOOLSET_FMT",
+                            str(tmp_path / "dts-{}"))
+        r = CompilerRegistry(bundle_dirs=["/nonexistent-bundles"])
+        assert r.environments() == []
+
+    def test_bundle_named_after_project_still_scans(self, tmp_path,
+                                                    monkeypatch):
+        """Wrapper markers match the basename only: a bundle root
+        containing 'yadcc' in its PATH must not disqualify the
+        compilers inside (reference IsCompilerWrapper uses EndsWith)."""
+        bundle = tmp_path / "yadcc-toolchains"
+        b = bundle / "gcc-12" / "bin"
+        b.mkdir(parents=True)
+        fake = TESTDATA / "toolchains" / "bin" / "g++"
+        (b / "g++").write_bytes(fake.read_bytes())
+        (b / "g++").chmod(0o755)
+        monkeypatch.setenv("PATH", str(tmp_path / "nothing"))
+        from yadcc_tpu.daemon.cloud import compiler_registry as cr
+        monkeypatch.setattr(cr, "_DEVTOOLSET_FMT",
+                            str(tmp_path / "dts-{}"))
+        r = CompilerRegistry(bundle_dirs=[str(bundle)])
+        envs = r.environments()
+        assert len(envs) == 1
+        assert r.try_get_compiler_path(envs[0]).endswith("gcc-12/bin/g++")

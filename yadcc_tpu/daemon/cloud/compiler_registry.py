@@ -23,9 +23,20 @@ _COMPILER_NAMES = ("gcc", "g++", "clang", "clang++", "cc", "c++")
 _WRAPPER_MARKERS = ("ccache", "distcc", "icecc", "ytpu", "yadcc")
 
 
+# RHEL devtoolset roots the reference probes unconditionally
+# (compiler_registry.cc:224-230).
+_DEVTOOLSET_FMT = "/opt/rh/devtoolset-{}/root/bin"
+
+
 class CompilerRegistry:
-    def __init__(self, extra_dirs: Sequence[str] = ()):
+    def __init__(self, extra_dirs: Sequence[str] = (),
+                 bundle_dirs: Sequence[str] = ()):
+        """bundle_dirs: parent directories holding whole toolchain
+        bundles; every `<bundle>/*/bin` is scanned like a PATH entry
+        (reference --extra_compiler_bundle_dirs,
+        compiler_registry.cc:51-56,210-222)."""
         self._extra_dirs = list(extra_dirs)
+        self._bundle_dirs = list(bundle_dirs)
         self._lock = threading.Lock()
         self._by_digest: Dict[str, str] = {}
         self._digest_memo: Dict[tuple, str] = {}  # (real, size, mtime)
@@ -46,6 +57,7 @@ class CompilerRegistry:
     def rescan(self) -> None:
         """60s-cadence timer body."""
         dirs = os.environ.get("PATH", "").split(os.pathsep) + self._extra_dirs
+        dirs += self._enumerate_bundle_bins()
         found: Dict[str, str] = {}
         for d in dirs:
             if not d:
@@ -74,6 +86,26 @@ class CompilerRegistry:
             logger.info("registered compiler %s (%s)", found[digest],
                         digest[:16])
 
+    def _enumerate_bundle_bins(self) -> List[str]:
+        """`<bundle>/*/bin` for every configured bundle dir, plus the
+        reference's unconditional RHEL devtoolset ladder.  Non-dirs and
+        unreadable entries are skipped silently, like the reference."""
+        out: List[str] = []
+        for bundle in self._bundle_dirs:
+            try:
+                subdirs = sorted(os.listdir(bundle))
+            except OSError:
+                continue
+            for sub in subdirs:
+                d = os.path.join(bundle, sub, "bin")
+                if os.path.isdir(d):
+                    out.append(d)
+        for i in range(1, 100):
+            d = _DEVTOOLSET_FMT.format(i)
+            if os.path.isdir(d):
+                out.append(d)
+        return out
+
     @staticmethod
     def _resolve_usable(p: Path) -> Optional[str]:
         """Real path of a usable compiler binary; None for wrappers,
@@ -84,8 +116,11 @@ class CompilerRegistry:
             real = p.resolve(strict=True)
         except OSError:
             return None
-        lowered = str(real).lower()
-        if any(m in lowered for m in _WRAPPER_MARKERS):
+        # Wrapper detection matches the BASENAME only (reference
+        # IsCompilerWrapper uses EndsWith): a bundle installed under
+        # e.g. /opt/yadcc/toolchains must not disqualify every
+        # compiler inside it.
+        if any(m in real.name.lower() for m in _WRAPPER_MARKERS):
             return None
         # A symlink chain passing through a wrapper name also disqualifies.
         hop = p

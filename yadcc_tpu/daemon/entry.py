@@ -63,6 +63,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--dedicated", action="store_true")
     p.add_argument("--max-remote-tasks", type=int, default=0)
     p.add_argument("--extra-compiler-dirs", default="")
+    p.add_argument("--extra-compiler-bundle-dirs", default="",
+                   help="parent dirs of whole toolchain bundles; every "
+                   "<bundle>/*/bin is scanned (reference "
+                   "--extra_compiler_bundle_dirs)")
     p.add_argument("--temporary-dir", default="")
     p.add_argument("--allow-poor-machine", action="store_true",
                    help="serve even with <=16 cores (small test rigs)")
@@ -155,7 +159,9 @@ def daemon_start(args) -> None:
                                   allow_poor_machine=args.allow_poor_machine,
                                   cgroup_present=cgroup_present)
     registry = CompilerRegistry(
-        [d for d in args.extra_compiler_dirs.split(",") if d])
+        [d for d in args.extra_compiler_dirs.split(",") if d],
+        bundle_dirs=[d for d in
+                     args.extra_compiler_bundle_dirs.split(",") if d])
     engine = ExecutionEngine(max_concurrency=max(capacity, 1))
     servant_server = GrpcServer(f"0.0.0.0:{args.serving_port}")
     config.location = args.location or \
