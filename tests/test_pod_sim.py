@@ -1,0 +1,91 @@
+"""Pod-scale control-plane sim (tools/pod_sim.py): deterministic
+mechanics plus a small end-to-end run.  The committed artifact
+(artifacts/pod_sim_50k.json) is the >=50k-TU version of the same run."""
+
+import time
+
+import pytest
+
+from yadcc_tpu.tools.pod_sim import PodSim
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestMechanics:
+    @pytest.fixture
+    def sim(self):
+        s = PodSim(servants=8, capacity=4, policy="greedy_cpu",
+                   exec_ms=1.0, churn_per_s=0)
+        s._sync_replica()
+        yield s
+        s._stop.set()
+        with s.ev_cv:
+            s.ev_cv.notify_all()
+        s.dispatcher.stop()
+
+    def test_run_join_hit_ladder(self, sim):
+        import threading
+
+        threads = [threading.Thread(target=f, daemon=True) for f in
+                   (sim._completion_loop, sim._grant_pump)]
+        for t in threads:
+            t.start()
+        d = "a" * 64
+        assert sim.submit(d) == "run"
+        # A duplicate arriving while the first is in flight joins it.
+        with sim.run_lock:
+            comp = sim.running.get(d)
+        if comp is not None and not comp.done.is_set():
+            assert sim.submit(d) in ("join", "hit")
+        assert _wait(lambda: d not in sim.running)
+        # After completion + a Bloom replica sync, it's a cache hit.
+        sim._sync_replica()
+        assert sim.submit(d) == "hit"
+        assert sim.stats["actually_run"] == 1
+        assert sim.stats["hit_cache"] >= 1
+        # The scheduler really granted and freed the task.
+        disp = sim.dispatcher.inspect()
+        assert disp["stats"]["granted"] == 1
+        assert disp["grants_outstanding"] == 0
+
+    def test_churn_releases_and_retries(self):
+        sim = PodSim(servants=4, capacity=2, policy="greedy_cpu",
+                     exec_ms=1.0, churn_per_s=0)
+        sim._sync_replica()
+        try:
+            # Graceful leave of a servant with no running tasks drops it
+            # from the pool; the fleet is replenished.
+            with sim.fleet_lock:
+                n0 = len(sim.servant_running)
+                loc = next(iter(sim.servant_running))
+                sim.servant_running.pop(loc)
+            sim._join_fleet()
+            sim.dispatcher.keep_servant_alive(
+                sim._ServantInfo(location=loc), 0.0)
+            sim.bookkeeper.drop_servant(loc)
+            with sim.fleet_lock:
+                assert len(sim.servant_running) == n0
+            assert loc not in sim.dispatcher.inspect()["servants"]
+        finally:
+            sim._stop.set()
+            sim.dispatcher.stop()
+
+
+def test_small_end_to_end_run():
+    sim = PodSim(servants=32, capacity=4, policy="greedy_cpu",
+                 exec_ms=4.0, churn_per_s=1)
+    out = sim.run(4000, dup_rate=0.4, submitters=4)
+    b = out["breakdown"]
+    assert out["tasks"] == 4000
+    assert b["hit_cache"] + b["reused"] + b["actually_run"] == 4000
+    assert b["actually_run"] >= 2400  # at least the unique TUs
+    assert out["tasks_per_sec"] > 100
+    assert out["grants_granted"] == out["scheduler_stats"]["granted"]
+    assert out["cache"]["fills"] == b["actually_run"] + b["retries"]

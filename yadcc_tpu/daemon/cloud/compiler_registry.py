@@ -59,6 +59,7 @@ class CompilerRegistry:
         dirs = os.environ.get("PATH", "").split(os.pathsep) + self._extra_dirs
         dirs += self._enumerate_bundle_bins()
         found: Dict[str, str] = {}
+        memo_live = set()
         for d in dirs:
             if not d:
                 continue
@@ -78,10 +79,18 @@ class CompilerRegistry:
                             self._digest_memo[memo_key] = digest
                 except OSError:
                     continue
+                memo_live.add(memo_key)
                 found.setdefault(digest, str(p))
         with self._lock:
             added = set(found) - set(self._by_digest)
             self._by_digest = found
+            # Self-clean the digest memo: entries for file versions no
+            # longer on disk (toolchain upgrades bump mtime/size every
+            # rescan) would otherwise accumulate for the daemon's
+            # lifetime.
+            self._digest_memo = {k: v for k, v in
+                                 self._digest_memo.items()
+                                 if k in memo_live}
         for digest in added:
             logger.info("registered compiler %s (%s)", found[digest],
                         digest[:16])

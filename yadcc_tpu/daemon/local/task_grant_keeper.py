@@ -42,6 +42,8 @@ class _EnvFetcher:
         self.waiters = 0
         self.lock = threading.Lock()
         self.wake = threading.Event()
+        self.retired = threading.Event()
+        self.last_used = time.monotonic()
         self.thread = threading.Thread(
             target=self._loop, name=f"grant-fetch-{env_digest[:8]}",
             daemon=True)
@@ -51,6 +53,7 @@ class _EnvFetcher:
         deadline = time.monotonic() + timeout_s
         with self.lock:
             self.waiters += 1
+            self.last_used = time.monotonic()
         self.wake.set()
         try:
             while True:
@@ -70,8 +73,28 @@ class _EnvFetcher:
             with self.lock:
                 self.waiters -= 1
 
+    def retire(self) -> None:
+        """Stop the fetch thread and hand queued grants back.  Called
+        with no waiters; late racers re-create a fresh fetcher.  The
+        loop drains again on exit: a fetch in flight during this drain
+        would otherwise strand its grants in the orphaned queue."""
+        self.retired.set()
+        self.wake.set()
+        self._drain_and_free()
+
+    def _drain_and_free(self) -> None:
+        stale = []
+        while True:
+            try:
+                stale.append(self.queue.get_nowait().grant_id)
+            except queue.Empty:
+                break
+        if stale:
+            self.keeper._free_async(stale)
+
     def _loop(self) -> None:
-        while not self.keeper._stopping.is_set():
+        while not (self.keeper._stopping.is_set()
+                   or self.retired.is_set()):
             self.wake.wait(timeout=0.5)
             self.wake.clear()
             with self.lock:
@@ -89,9 +112,20 @@ class _EnvFetcher:
                     usable_until=now + _LEASE_S - _NETWORK_TOLERANCE_S))
             if not grants:
                 time.sleep(0.1)  # scheduler dry: don't hammer it
+        if self.retired.is_set():
+            # A fetch that was in flight when retire() drained may have
+            # enqueued grants after that drain: free them too, or the
+            # scheduler holds those slots until the lease expires.
+            self._drain_and_free()
 
 
 class TaskGrantKeeper:
+    # A fetcher for a compiler env nobody has used in this long is
+    # retired (thread stopped, queued grants freed): a delegate in a
+    # fleet with rotating toolchains must not accumulate one thread +
+    # queue per env digest it has EVER seen.
+    IDLE_FETCHER_TTL_S = 600.0
+
     def __init__(self, scheduler_uri: str, token: str,
                  min_version: int = 0):
         self._uri = scheduler_uri
@@ -103,11 +137,23 @@ class TaskGrantKeeper:
         self._channel: Optional[Channel] = None
 
     def get(self, env_digest: str, timeout_s: float = 10.0) -> Optional[Grant]:
+        now = time.monotonic()
+        retire = []
         with self._lock:
+            for digest, f in list(self._fetchers.items()):
+                if (digest != env_digest and f.waiters == 0
+                        and now - f.last_used > self.IDLE_FETCHER_TTL_S):
+                    retire.append(self._fetchers.pop(digest))
             f = self._fetchers.get(env_digest)
-            if f is None:
+            if f is None or f.retired.is_set():
                 f = _EnvFetcher(self, env_digest)
                 self._fetchers[env_digest] = f
+            # Refresh under the keeper lock: the idle scan above runs
+            # under the same lock, so a fetcher handed out here can
+            # never be judged stale before its waiter registers.
+            f.last_used = now
+        for r in retire:
+            r.retire()
         return f.get(timeout_s)
 
     def free(self, grant_ids) -> None:

@@ -1,0 +1,445 @@
+"""Pod-scale control-plane simulation: >=50k TUs over hundreds of
+virtual servants (BASELINE configs[0]/[2] analogue at fleet scale).
+
+`cluster_sim` drives the full wire path (real loopback gRPC, real
+subprocess compiles) at small scale; this tool answers the scale
+question the reference answers with its production cluster
+(yadcc/doc/benchmark.md:25-37): what does the CONTROL PLANE sustain
+when a build farm pushes tens of thousands of TUs at a fleet of
+hundreds of servants, with the distributed cache, Bloom gating,
+duplicate-task joining, and servant churn all live?
+
+Everything stateful is the REAL component, called in-process:
+
+* `TaskDispatcher` — the production scheduler core (policy kernels,
+  batched dispatch cycles, leases, churn bookkeeping);
+* `CacheService` — real ARC L1 + Bloom generator, driven through its
+  RPC handlers (FetchBloomFilter / TryGetEntry / PutEntry) with the
+  production sync-age protocol;
+* `SaltedBloomFilter` client replica, synced incrementally like
+  DistributedCacheReader;
+* `RunningTaskBookkeeper` — fed from virtual heartbeats, queried for
+  cross-machine dedup like RunningTaskKeeper.
+
+Virtual: the servants (no subprocesses — each "compile" is an event on
+a heap with a configurable duration) and the build clients (a submit
+loop replaces the per-TU client/daemon HTTP hop).  Task *latency* here
+is therefore not an end-to-end claim — cluster_sim covers that — but
+tasks/s, grant p99, and the hit/join/run breakdown exercise the same
+code a deployment does.
+
+    python -m yadcc_tpu.tools.pod_sim --tasks 50000 --servants 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _Completion:
+    """One running (possibly shared) compilation: joiners piggyback."""
+
+    __slots__ = ("digest", "grant_id", "location", "done", "joiners")
+
+    def __init__(self, digest: str, grant_id: int, location: str):
+        self.digest = digest
+        self.grant_id = grant_id
+        self.location = location
+        self.done = threading.Event()
+        self.joiners = 1
+
+
+class PodSim:
+    def __init__(self, servants: int, capacity: int, policy: str,
+                 exec_ms: float, churn_per_s: int, seed: int = 7,
+                 pipeline_depth: int = 0):
+        from ..cache.cache_engine import NullCacheEngine
+        from ..cache.in_memory_cache import InMemoryCache
+        from ..cache.service import CacheService
+        from ..scheduler.policy import make_policy
+        from ..scheduler.running_task_bookkeeper import \
+            RunningTaskBookkeeper
+        from ..scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+
+        self.rng = np.random.default_rng(seed)
+        self.exec_ms = exec_ms
+        self.churn_per_s = churn_per_s
+        self.capacity = capacity
+        self.env = "c" * 64
+        pool = 1 << max(9, (servants * 2 - 1).bit_length())
+        pol = make_policy(policy, max_servants=pool, avoid_self=False)
+        # Like scheduler/entry.py: device kernels compile before
+        # serving, never inside a live grant cycle.
+        if pipeline_depth > 0:
+            pol.stream_warmup(pool)
+        else:
+            pol.warmup(pool)
+        self.dispatcher = TaskDispatcher(
+            pol, max_servants=pool, batch_window_s=0.001,
+            min_memory_for_new_task=1,
+            pipeline_depth=pipeline_depth)
+        self.bookkeeper = RunningTaskBookkeeper()
+        self.cache = CacheService(InMemoryCache(256 << 20),
+                                  NullCacheEngine())
+        self._ServantInfo = ServantInfo
+
+        # Virtual fleet.
+        self._next_servant = 0
+        self.servant_running: Dict[str, Dict[int, str]] = {}
+        self.fleet_lock = threading.Lock()
+        for _ in range(servants):
+            self._join_fleet()
+
+        # Client-side state (one logical build farm client).
+        self.replica = None          # SaltedBloomFilter
+        self._last_full_fetch = 0.0
+        self._last_fetch = 0.0
+        self.running: Dict[str, _Completion] = {}
+        self.run_lock = threading.Lock()
+        self.grants: "queue.Queue[Tuple[int, str]]" = queue.Queue()
+        self.need = 0                # tasks waiting for a grant
+        self.need_lock = threading.Lock()
+        self.events: List[Tuple[float, int, _Completion]] = []
+        self.ev_lock = threading.Lock()
+        self.ev_cv = threading.Condition(self.ev_lock)
+        self._seq = 0
+        self.stats = {"hit_cache": 0, "reused": 0, "actually_run": 0,
+                      "bloom_rejects": 0, "retries": 0,
+                      "servants_churned": 0}
+        self.grant_lat_ms: List[float] = []
+        self.grant_calls = 0
+        self.grants_granted = 0
+        self._stop = threading.Event()
+
+    # -- fleet ---------------------------------------------------------------
+
+    def _join_fleet(self) -> str:
+        """Register a fresh virtual servant.  Takes fleet_lock itself —
+        callers must NOT hold it (lock order: fleet_lock is a leaf)."""
+        with self.fleet_lock:
+            loc = f"10.{self._next_servant >> 8 & 255}." \
+                  f"{self._next_servant & 255}.1:8335"
+            self._next_servant += 1
+            self.servant_running[loc] = {}
+        self._heartbeat_one(loc)
+        return loc
+
+    def _heartbeat_one(self, loc: str) -> None:
+        from ..scheduler.running_task_bookkeeper import RunningTaskRecord
+
+        with self.fleet_lock:
+            running = dict(self.servant_running.get(loc, {}))
+        info = self._ServantInfo(
+            location=loc, version=1,
+            num_processors=self.capacity * 2,
+            current_load=0, dedicated=True,
+            capacity=self.capacity,
+            total_memory=64 << 30, memory_available=32 << 30,
+            env_digests=(self.env,),
+        )
+        self.dispatcher.keep_servant_alive(info, 10.0)
+        self.dispatcher.notify_servant_running_tasks(
+            loc, list(running.keys()))
+        self.bookkeeper.set_servant_running_tasks(
+            loc, [RunningTaskRecord(servant_task_id=gid,
+                                    task_grant_id=gid,
+                                    servant_location=loc,
+                                    task_digest=digest)
+                  for gid, digest in running.items()])
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(0.5):
+            with self.fleet_lock:
+                locs = list(self.servant_running)
+            for loc in locs:
+                self._heartbeat_one(loc)
+            self.dispatcher.on_expiration_timer()
+
+    def _churn_loop(self) -> None:
+        """Every second: `churn_per_s` random servants leave gracefully
+        and are replaced by fresh machines — the scheduler's pool
+        arrays, env rows, and grant bookkeeping all take the hit."""
+        while not self._stop.wait(1.0):
+            for _ in range(self.churn_per_s):
+                with self.fleet_lock:
+                    locs = list(self.servant_running)
+                    if len(locs) < 2:
+                        continue
+                    loc = locs[int(self.rng.integers(len(locs)))]
+                    orphans = list(self.servant_running.pop(loc).values())
+                self._join_fleet()
+                info = self._ServantInfo(location=loc)
+                self.dispatcher.keep_servant_alive(info, 0.0)  # leave
+                self.bookkeeper.drop_servant(loc)
+                self.stats["servants_churned"] += 1
+                # Tasks that were running there restart elsewhere (the
+                # delegate's retry ladder).
+                for digest in orphans:
+                    with self.run_lock:
+                        comp = self.running.get(digest)
+                    if comp is not None and not comp.done.is_set():
+                        self.stats["retries"] += 1
+                        self._dispatch(comp)
+
+    # -- scheduler interaction ----------------------------------------------
+
+    def _grant_pump(self) -> None:
+        """TaskGrantKeeper analogue: one fetcher per compiler env,
+        batching `immediate` to the current number of waiters."""
+        while not self._stop.is_set():
+            with self.need_lock:
+                n = self.need
+            if n <= 0:
+                time.sleep(0.0005)
+                continue
+            n = min(n, 128)
+            t0 = time.perf_counter()
+            got = self.dispatcher.wait_for_starting_new_task(
+                self.env, immediate=n, lease_s=15.0, timeout_s=5.0,
+                requestor="10.255.0.1:9")
+            self.grant_lat_ms.append(
+                (time.perf_counter() - t0) * 1000.0)
+            self.grant_calls += 1
+            self.grants_granted += len(got)
+            if not got:
+                continue
+            with self.need_lock:
+                self.need -= len(got)
+            for g in got:
+                self.grants.put(g)
+
+    def _dispatch(self, comp: _Completion) -> None:
+        """Acquire a grant for `comp` and schedule its completion."""
+        with self.need_lock:
+            self.need += 1
+        gid, loc = self.grants.get()
+        comp.grant_id, comp.location = gid, loc
+        with self.fleet_lock:
+            srv = self.servant_running.get(loc)
+            if srv is not None:
+                srv[gid] = comp.digest
+        dt = float(self.rng.exponential(self.exec_ms)) / 1000.0
+        with self.ev_cv:
+            self._seq += 1
+            heapq.heappush(self.events,
+                           (time.monotonic() + dt, self._seq, comp))
+            self.ev_cv.notify()
+
+    def _completion_loop(self) -> None:
+        from .. import api
+        from ..rpc import RpcContext
+
+        while not self._stop.is_set():
+            with self.ev_cv:
+                while not self.events and not self._stop.is_set():
+                    self.ev_cv.wait(0.2)
+                if self._stop.is_set():
+                    return
+                due, _, comp = self.events[0]
+                now = time.monotonic()
+                if due > now:
+                    self.ev_cv.wait(min(due - now, 0.2))
+                    continue
+                heapq.heappop(self.events)
+            # "Compile" finished: fill the cache (real PutEntry with the
+            # servant token path), free the grant, wake joiners.
+            key = f"ytpu-cxx2-entry-{comp.digest}"
+            req = api.cache.PutEntryRequest(token="", key=key)
+            ctx = RpcContext(peer=comp.location)
+            self.cache.PutEntry(req, b"OBJ" + comp.digest.encode(), ctx)
+            self.dispatcher.free_task([comp.grant_id])
+            with self.fleet_lock:
+                srv = self.servant_running.get(comp.location)
+                if srv is not None:
+                    srv.pop(comp.grant_id, None)
+            with self.run_lock:
+                self.running.pop(comp.digest, None)
+            comp.done.set()
+
+    # -- client side ---------------------------------------------------------
+
+    def _sync_replica(self) -> None:
+        from .. import api
+        from ..common import compress
+        from ..common.bloom import SaltedBloomFilter
+        from ..rpc import RpcContext
+
+        now = time.monotonic()
+        req = api.cache.FetchBloomFilterRequest(
+            token="",
+            seconds_since_last_fetch=int(
+                max(1, now - self._last_fetch)),
+            seconds_since_last_full_fetch=(
+                int(max(1, now - self._last_full_fetch))
+                if self.replica is not None else 0),
+        )
+        ctx = RpcContext(peer="10.255.0.1:9")
+        resp = self.cache.FetchBloomFilter(req, b"", ctx)
+        if resp.incremental:
+            self.replica.add_many(list(resp.newly_populated_keys))
+        else:
+            raw = compress.decompress(ctx.response_attachment)
+            salt = int.from_bytes(raw[:4], "little")
+            self.replica = SaltedBloomFilter.from_bytes(
+                raw[4:], num_hashes=resp.num_hashes, salt=salt)
+            self._last_full_fetch = now
+        self._last_fetch = now
+
+    def _replica_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            self._sync_replica()
+
+    def submit(self, digest: str) -> str:
+        """One TU through the delegate decision ladder:
+        cache -> join running -> grant & run.  Returns the outcome."""
+        key = f"ytpu-cxx2-entry-{digest}"
+        if self.replica is not None and self.replica.may_contain(key):
+            from .. import api
+            from ..rpc import RpcContext, RpcError
+
+            try:
+                self.cache.TryGetEntry(
+                    api.cache.TryGetEntryRequest(token="", key=key),
+                    b"", RpcContext(peer="10.255.0.1:9"))
+                self.stats["hit_cache"] += 1
+                return "hit"
+            except RpcError:
+                pass  # Bloom false positive
+        else:
+            self.stats["bloom_rejects"] += 1
+        with self.run_lock:
+            comp = self.running.get(digest)
+            if comp is not None:
+                comp.joiners += 1
+                self.stats["reused"] += 1
+                return "join"
+            comp = _Completion(digest, -1, "")
+            self.running[digest] = comp
+        # Cross-machine visibility parity: the bookkeeper snapshot other
+        # delegates would consult (RunningTaskKeeper.TryFindTask).
+        self.stats["actually_run"] += 1
+        self._dispatch(comp)
+        return "run"
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self, tasks: int, dup_rate: float,
+            submitters: int = 8) -> dict:
+        n_unique = max(1, int(tasks * (1.0 - dup_rate)))
+        sources = [f"{i:08x}" + "s" * 56 for i in range(n_unique)]
+        picks = np.concatenate([
+            np.arange(n_unique),
+            self.rng.integers(0, n_unique, tasks - n_unique)])
+        self.rng.shuffle(picks)
+
+        self._sync_replica()
+        threads = [threading.Thread(target=f, daemon=True, name=n)
+                   for f, n in [(self._heartbeat_loop, "hb"),
+                                (self._churn_loop, "churn"),
+                                (self._completion_loop, "complete"),
+                                (self._replica_loop, "bloom"),
+                                (self._grant_pump, "grants")]]
+        work = queue.Queue()
+        for p in picks:
+            work.put(sources[p])
+        outcomes: List[_Completion] = []
+        out_lock = threading.Lock()
+
+        def submitter():
+            pending = []
+            while True:
+                try:
+                    digest = work.get_nowait()
+                except queue.Empty:
+                    break
+                self.submit(digest)
+                with self.run_lock:
+                    c = self.running.get(digest)
+                if c is not None:
+                    pending.append(c)
+            with out_lock:
+                outcomes.extend(pending)
+
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        subs = [threading.Thread(target=submitter, daemon=True)
+                for _ in range(submitters)]
+        for t in subs:
+            t.start()
+        for t in subs:
+            t.join(timeout=900)
+        # Wait for in-flight compiles to land.
+        deadline = time.monotonic() + 120
+        for c in outcomes:
+            c.done.wait(timeout=max(0.0, deadline - time.monotonic()))
+        wall = time.perf_counter() - t0
+        self._stop.set()
+        with self.ev_cv:
+            self.ev_cv.notify_all()
+        for t in threads:
+            t.join(timeout=10)
+        self.dispatcher.stop()
+
+        lat = np.array(self.grant_lat_ms) if self.grant_lat_ms else \
+            np.array([0.0])
+        disp = self.dispatcher.inspect()
+        done = sum(self.stats[k] for k in
+                   ("hit_cache", "reused", "actually_run"))
+        return {
+            "tasks": int(done),
+            "servants": len(self.servant_running),
+            "servant_capacity": self.capacity,
+            "policy": disp["policy"],
+            "exec_ms_mean": self.exec_ms,
+            "churn_per_s": self.churn_per_s,
+            "wall_seconds": round(wall, 2),
+            "tasks_per_sec": round(done / wall, 1),
+            "breakdown": {k: int(self.stats[k]) for k in
+                          ("hit_cache", "reused", "actually_run",
+                           "retries", "servants_churned")},
+            "grant_calls": int(self.grant_calls),
+            "grants_granted": int(self.grants_granted),
+            "grant_call_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "grant_call_p99_ms": round(float(np.percentile(lat, 99)), 2),
+            "scheduler_stats": disp["stats"],
+            "cache": self.cache.inspect(),
+            "_meta": {
+                "virtual": "servant execution + build clients "
+                           "(event-driven); scheduler, cache, bloom, "
+                           "bookkeeper are the production classes",
+            },
+        }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("ytpu-pod-sim")
+    ap.add_argument("--tasks", type=int, default=50000)
+    ap.add_argument("--servants", type=int, default=512)
+    ap.add_argument("--capacity", type=int, default=8)
+    ap.add_argument("--dup-rate", type=float, default=0.3)
+    ap.add_argument("--exec-ms", type=float, default=30.0)
+    ap.add_argument("--churn-per-s", type=int, default=2)
+    ap.add_argument("--policy", default="auto")
+    ap.add_argument("--pipeline-depth", type=int, default=0)
+    ap.add_argument("--submitters", type=int, default=8)
+    args = ap.parse_args()
+    sim = PodSim(args.servants, args.capacity, args.policy,
+                 args.exec_ms, args.churn_per_s,
+                 pipeline_depth=args.pipeline_depth)
+    print(json.dumps(sim.run(args.tasks, args.dup_rate,
+                             args.submitters), indent=2))
+
+
+if __name__ == "__main__":
+    from ..utils.device_guard import guard_device_entry
+
+    guard_device_entry(main, module="yadcc_tpu.tools.pod_sim")
