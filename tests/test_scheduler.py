@@ -562,3 +562,40 @@ def test_policy_warmup_covers_all_selectable_policies():
     for name in ("greedy_cpu", "jax_batched", "jax_grouped", "auto"):
         p = make_policy(name, 64, avoid_self=True)
         p.warmup(64)
+
+
+def test_auto_policy_measured_crossover():
+    """warmup() calibrates the greedy/device crossover by measurement
+    (a tunnel-attached device's RTT must land in the threshold, which
+    no pool-size formula can know)."""
+    from yadcc_tpu.scheduler.policy import (AssignRequest, AutoPolicy,
+                                            GreedyCpuPolicy,
+                                            JaxGroupedPolicy,
+                                            PoolSnapshot)
+
+    auto = AutoPolicy()
+    auto.warmup(64)
+    assert auto._measured_threshold is not None
+    assert auto._measured_threshold >= 1.0
+
+    # The measured threshold routes like the explicit one: build a
+    # policy whose device route is artificially 100x slower and check
+    # deep backlogs still pick the faster route.
+    import numpy as np
+
+    snap = PoolSnapshot(
+        alive=np.ones(64, bool),
+        capacity=np.full(64, 4, np.int32),
+        running=np.zeros(64, np.int32),
+        dedicated=np.zeros(64, bool),
+        version=np.ones(64, np.int32),
+        env_bitmap=np.full((64, 8), 0xFFFFFFFF, np.uint32),
+    )
+    # Outcomes agree on both sides of the crossover regardless of the
+    # measured value.
+    for n in (1, 8, 64):
+        reqs = [AssignRequest(2, 1, -1)] * n
+        import copy
+        want = GreedyCpuPolicy().assign(copy.deepcopy(snap), reqs)
+        got = auto.assign(copy.deepcopy(snap), reqs)
+        assert got == want

@@ -55,6 +55,19 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       && mv artifacts/trace_ab_tpu.json.tmp artifacts/trace_ab_tpu.json \
       && echo "$(date -Is) trace_ab_tpu.json captured" >> "$LOG"
     rm -f "$TRACE"
+    # 2b. Pod-scale trace A/B (20k tasks x 5120 servants — the
+    #     reference's documented scaling cliff) ON the device, with
+    #     the auto policy in the panel: the design-thesis artifact.
+    TRACEP=$(mktemp /tmp/ytpu_tracep.XXXX.jsonl)
+    python -m yadcc_tpu.tools.trace_replay "$TRACEP" --generate \
+      --tasks 20000 --servants 5120 >> "$LOG" 2>&1
+    timeout "$TOOL_TIMEOUT" env YTPU_DEVICE_GUARD_CHILD=1 \
+      python -u -m yadcc_tpu.tools.trace_replay "$TRACEP" \
+      > artifacts/trace_ab_pod_tpu.json.tmp 2>> "$LOG" \
+      && mv artifacts/trace_ab_pod_tpu.json.tmp \
+           artifacts/trace_ab_pod_tpu.json \
+      && echo "$(date -Is) trace_ab_pod_tpu.json captured" >> "$LOG"
+    rm -f "$TRACEP"
     # 3. Bloom membership kernel at the production geometry
     #    (BASELINE configs[3]).
     timeout "$TOOL_TIMEOUT" env YTPU_DEVICE_GUARD_CHILD=1 \

@@ -1,0 +1,221 @@
+"""Device-side XXH64: the Bloom fingerprint computed ON the TPU.
+
+Round-2's bloom_bench showed the device probe fed by HOST
+fingerprinting: 0.87s of per-key hashing ahead of an 0.08s probe.
+This module moves the hash itself onto the device so the fused
+fingerprint+probe pipeline (ops/bloom_probe.py) consumes raw key
+bytes — the host's only job is packing a byte matrix.
+
+64-bit arithmetic rides (hi, lo) uint32 pairs — TPUs have no native
+u64, and enabling jax x64 globally would change default dtypes across
+the whole process.  Multiplication decomposes into 16-bit limbs whose
+partial products accumulate in u32 with explicit carry propagation;
+every op is elementwise vector math (VPU-shaped: no gathers, no
+data-dependent control flow), so XLA fuses the whole digest into a
+handful of passes over the [N] lanes.
+
+Bit-identical to the XXH64 spec: tests cross-check against the
+vectorized numpy reference (common/xxh64_np.py), which is itself
+checked against the C `xxhash` wheel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_M16 = jnp.uint32(0xFFFF)
+
+
+def _split(v: int) -> Tuple[jnp.uint32, jnp.uint32]:
+    return jnp.uint32(v >> 32), jnp.uint32(v & 0xFFFFFFFF)
+
+
+P1 = (0x9E3779B1, 0x85EBCA87)
+P2 = (0xC2B2AE3D, 0x27D4EB4F)
+P3 = (0x165667B1, 0x9E3779F9)
+P4 = (0x85EBCA77, 0xC2B2AE63)
+P5 = (0x27D4EB2F, 0x165667C5)
+
+
+def _const(p) -> Tuple[jnp.uint32, jnp.uint32]:
+    return jnp.uint32(p[0]), jnp.uint32(p[1])
+
+
+def add64(a, b):
+    ah, al = a
+    bh, bl = b
+    lo = al + bl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def mul64(a, b):
+    """Low 64 bits of a*b via 16-bit limb decomposition.  Partial
+    products are < 2^32 and at most a handful accumulate per limb, so
+    u32 accumulators with one carry-propagation pass suffice."""
+    ah, al = a
+    bh, bl = b
+    a0, a1 = al & _M16, al >> 16
+    a2, a3 = ah & _M16, ah >> 16
+    b0, b1 = bl & _M16, bl >> 16
+    b2, b3 = bh & _M16, bh >> 16
+
+    # acc[k] collects 16-bit-limb contributions at position 16k; each
+    # partial product contributes its low half to k and high half to
+    # k+1.  Counts per limb stay tiny, far from u32 overflow.
+    acc0 = jnp.zeros_like(al)
+    acc1 = jnp.zeros_like(al)
+    acc2 = jnp.zeros_like(al)
+    acc3 = jnp.zeros_like(al)
+
+    def contrib(acc_k, acc_k1, x, y):
+        p = x * y
+        return acc_k + (p & _M16), acc_k1 + (p >> 16)
+
+    acc0, acc1 = contrib(acc0, acc1, a0, b0)
+    acc1, acc2 = contrib(acc1, acc2, a0, b1)
+    acc1, acc2 = contrib(acc1, acc2, a1, b0)
+    acc2, acc3 = contrib(acc2, acc3, a0, b2)
+    acc2, acc3 = contrib(acc2, acc3, a1, b1)
+    acc2, acc3 = contrib(acc2, acc3, a2, b0)
+    # Position 3's high halves would land at position 4 (>= 2^64):
+    # dropped, exactly the spec's mod-2^64 wrap.
+    acc3 = acc3 + (a0 * b3 & _M16) + (a1 * b2 & _M16) \
+        + (a2 * b1 & _M16) + (a3 * b0 & _M16)
+
+    r0 = acc0 & _M16
+    acc1 = acc1 + (acc0 >> 16)
+    r1 = acc1 & _M16
+    acc2 = acc2 + (acc1 >> 16)
+    r2 = acc2 & _M16
+    acc3 = acc3 + (acc2 >> 16)
+    r3 = acc3 & _M16
+    return (r3 << 16) | r2, (r1 << 16) | r0
+
+
+def xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def rotl64(a, r: int):
+    ah, al = a
+    r %= 64
+    if r == 0:
+        return a
+    if r == 32:
+        return al, ah
+    if r < 32:
+        s = jnp.uint32(r)
+        t = jnp.uint32(32 - r)
+        return (ah << s) | (al >> t), (al << s) | (ah >> t)
+    s = jnp.uint32(r - 32)
+    t = jnp.uint32(64 - r)
+    return (al << s) | (ah >> t), (ah << s) | (al >> t)
+
+
+def shr64(a, r: int):
+    ah, al = a
+    if r == 0:
+        return a
+    if r >= 32:
+        return jnp.zeros_like(ah), ah >> jnp.uint32(r - 32)
+    s = jnp.uint32(r)
+    t = jnp.uint32(32 - r)
+    return ah >> s, (al >> s) | (ah << t)
+
+
+def _round(acc, lane):
+    acc = add64(acc, mul64(lane, _const(P2)))
+    return mul64(rotl64(acc, 31), _const(P1))
+
+
+def _merge_round(h, acc):
+    h = xor64(h, _round((jnp.zeros_like(acc[0]),) * 2, acc))
+    return add64(mul64(h, _const(P1)), _const(P4))
+
+
+def _avalanche(h):
+    h = mul64(xor64(h, shr64(h, 33)), _const(P2))
+    h = mul64(xor64(h, shr64(h, 29)), _const(P3))
+    return xor64(h, shr64(h, 32))
+
+
+@functools.partial(jax.jit, static_argnames=("length",))
+def xxh64_device(words: jax.Array, length: int,
+                 seed: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """XXH64 of N keys of `length` bytes each.
+
+    words: uint32[N, ceil(length/8)*2] little-endian packed key bytes
+    (zero-padded; see pack_keys).  seed: uint32[2] as (hi, lo).
+    Returns (hi, lo) uint32[N] digest pairs.
+
+    All u64 reads land on 8-byte offsets and the sole u32 read on a
+    4-byte offset (stripes consume 32 bytes, the tail loop 8), so
+    every read is a static column pair — the Python loop below
+    unrolls at trace time into pure vector ops.
+    """
+    n = words.shape[0]
+    seed64 = (jnp.broadcast_to(seed[0], (n,)).astype(jnp.uint32),
+              jnp.broadcast_to(seed[1], (n,)).astype(jnp.uint32))
+
+    def u64_at(off):
+        return words[:, off // 4 + 1], words[:, off // 4]
+
+    pos = 0
+    if length >= 32:
+        acc1 = add64(add64(seed64, _const(P1)), _const(P2))
+        acc2 = add64(seed64, _const(P2))
+        acc3 = seed64
+        # seed - P1 == seed + (2^64 - P1)
+        negp1 = (0xFFFFFFFFFFFFFFFF - ((P1[0] << 32) | P1[1])) + 1
+        acc4 = add64(seed64, _split(negp1))
+        while pos + 32 <= length:
+            acc1 = _round(acc1, u64_at(pos))
+            acc2 = _round(acc2, u64_at(pos + 8))
+            acc3 = _round(acc3, u64_at(pos + 16))
+            acc4 = _round(acc4, u64_at(pos + 24))
+            pos += 32
+        h = add64(add64(rotl64(acc1, 1), rotl64(acc2, 7)),
+                  add64(rotl64(acc3, 12), rotl64(acc4, 18)))
+        h = _merge_round(h, acc1)
+        h = _merge_round(h, acc2)
+        h = _merge_round(h, acc3)
+        h = _merge_round(h, acc4)
+    else:
+        h = add64(seed64, _const(P5))
+    h = add64(h, _split(length))
+
+    while pos + 8 <= length:
+        zero = (jnp.zeros(n, jnp.uint32), jnp.zeros(n, jnp.uint32))
+        h = xor64(h, _round(zero, u64_at(pos)))
+        h = add64(mul64(rotl64(h, 27), _const(P1)), _const(P4))
+        pos += 8
+    if pos + 4 <= length:
+        u32 = (jnp.zeros(n, jnp.uint32), words[:, pos // 4])
+        h = xor64(h, mul64(u32, _const(P1)))
+        h = add64(mul64(rotl64(h, 23), _const(P2)), _const(P3))
+        pos += 4
+    while pos < length:
+        byte = (words[:, pos // 4] >> jnp.uint32(8 * (pos % 4))) \
+            & jnp.uint32(0xFF)
+        h = xor64(h, mul64((jnp.zeros(n, jnp.uint32), byte),
+                           _const(P5)))
+        h = mul64(rotl64(h, 11), _const(P1))
+        pos += 1
+    return _avalanche(h)
+
+
+def pack_keys(keys, length: int) -> np.ndarray:
+    """[N, ceil(length/8)*2] uint32 little-endian key-byte matrix for
+    xxh64_device; every key must be exactly `length` bytes."""
+    n = len(keys)
+    w = -(-length // 8) * 2          # u32 words, 8-byte aligned
+    mat = np.zeros((n, w * 4), np.uint8)
+    buf = np.frombuffer(b"".join(keys), np.uint8).reshape(n, length)
+    mat[:, :length] = buf
+    return np.ascontiguousarray(mat).view("<u4")

@@ -777,14 +777,18 @@ class AutoPolicy(DispatchPolicy):
     microseconds), deeper backlogs take the grouped device kernel (the
     measured throughput winner, artifacts/trace_ab.json).
 
-    The crossover depends on POOL size, because the greedy scan is
-    O(S) per request while the grouped kernel's cost is one ~flat call:
-    measured on CPU, greedy ~ n*S*0.75us vs grouped ~ 0.6ms + S*0.9us,
-    giving a crossover near n* = 800/S + 1.2 — a lone request always
-    goes greedy, but at 5k servants even TWO requests already favor the
-    kernel (the host scan is 3.7ms/request there).  Outcome equivalence
-    between the two routes is enforced by the golden tests, so
-    switching is purely a latency/throughput trade."""
+    The crossover is MEASURED at warmup, not assumed: the greedy scan
+    costs ~n*S per request while the device call is ~flat, but the
+    flat part depends on the deployment — microseconds of dispatch
+    overhead co-located, a full transport RTT when the accelerator is
+    tunnel-attached (this harness: ~65ms).  warmup() times one greedy
+    request and one device call on a synthetic pool of the serving
+    size and sets the crossover where the measured curves intersect —
+    so `auto >= max(greedy, device)` holds on ANY deployment (the
+    trace A/B asserts it).  Before calibration (warmup not yet run) an
+    analytic CPU-calibrated fallback applies: n* = 800/S + 1.2.
+    Outcome equivalence between the two routes is enforced by the
+    golden tests, so switching is purely a latency/throughput trade."""
 
     name = "auto"
 
@@ -794,10 +798,55 @@ class AutoPolicy(DispatchPolicy):
         self._greedy = GreedyCpuPolicy(cost_model)
         self._grouped = JaxGroupedPolicy(cost_model=cost_model)
         self._threshold = device_threshold  # None = pool-size adaptive
+        self._measured_threshold: "float | None" = None
         self._device_dead = False
 
     def warmup(self, pool_size: int, env_words: int = 8) -> None:
         self._grouped.warmup(pool_size, env_words)
+        self._calibrate(pool_size, env_words)
+
+    def _calibrate(self, pool_size: int, env_words: int) -> None:
+        """Time both routes on a synthetic pool of the serving size and
+        place the crossover where they intersect.  The device call is
+        timed end-to-end (upload + kernel + download), so a remote-
+        attached accelerator's transport RTT lands in the threshold —
+        the whole point: the analytic model knows S, only a measurement
+        knows the deployment."""
+        import time as _time
+
+        import numpy as _np
+
+        def mksnap():
+            s = pool_size
+            return PoolSnapshot(
+                alive=_np.ones(s, bool),
+                capacity=_np.full(s, 4, _np.int32),
+                running=_np.zeros(s, _np.int32),
+                dedicated=_np.zeros(s, bool),
+                version=_np.ones(s, _np.int32),
+                env_bitmap=_np.full((s, env_words), 0xFFFFFFFF,
+                                    _np.uint32),
+            )
+
+        reqs = [AssignRequest(1, 1, -1)] * 8
+        try:
+            self._grouped.assign(mksnap(), reqs)   # compile/warm path
+            t0 = _time.perf_counter()
+            self._grouped.assign(mksnap(), reqs)
+            device_call_s = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            self._greedy.assign(mksnap(), reqs)
+            greedy_per_req_s = (_time.perf_counter() - t0) / len(reqs)
+            self._measured_threshold = max(
+                1.0, device_call_s / max(greedy_per_req_s, 1e-9))
+            logger.info(
+                "auto crossover calibrated: device call %.3fms, greedy "
+                "%.3fms/req, threshold n*=%.1f (pool %d)",
+                device_call_s * 1e3, greedy_per_req_s * 1e3,
+                self._measured_threshold, pool_size)
+        except Exception:
+            logger.exception("auto calibration failed; keeping the "
+                             "analytic crossover")
 
     # In pipelined mode every launch goes through the grouped device
     # kernel — the greedy host shortcut only exists to dodge the device
@@ -824,6 +873,8 @@ class AutoPolicy(DispatchPolicy):
     def _use_greedy(self, snap, n: int) -> bool:
         if self._threshold is not None:
             return n < self._threshold
+        if self._measured_threshold is not None:
+            return n < self._measured_threshold
         s = max(1, int(snap.alive.shape[0]))
         return n < 800 / s + 1.2
 

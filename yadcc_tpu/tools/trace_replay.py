@@ -120,11 +120,18 @@ def replay(path: str, policies: Dict[str, object] | None = None) -> dict:
     if policies is None:
         from ..scheduler.policy import JaxShardedPolicy
 
+        from ..scheduler.policy import AutoPolicy
+
         s = len(events[0]["servants"])
         policies = {
             "greedy_cpu": GreedyCpuPolicy(),
             "jax_batched": JaxBatchedPolicy(max_servants=s),
             "jax_grouped": JaxGroupedPolicy(),
+            # The production default: greedy for tiny backlogs, device
+            # kernel for deep ones.  The A/B contract for it is `auto
+            # >= max(greedy, device)` within measurement noise — the
+            # crossover must never pick the losing route.
+            "auto": AutoPolicy(),
         }
         try:
             # Requires S to divide over the attached devices; on a
