@@ -224,17 +224,28 @@ class TestRunningTaskKeeper:
 
 
 class TestDispatcherFlows:
+    @pytest.fixture(autouse=True)
+    def _stop_dispatchers(self):
+        # Un-stopped dispatchers leak one grant-fetch thread per env
+        # into every later test's thread census (test_memory_bounds).
+        self._made = []
+        yield
+        for d in self._made:
+            d.stop()
+
     def _mk(self, cluster, cache_reader=None, running_keeper=None,
             pid_prober=None):
         ck = ConfigKeeper("mock://sched", token="")
         ck.refresh_once()
-        return DistributedTaskDispatcher(
+        d = DistributedTaskDispatcher(
             grant_keeper=TaskGrantKeeper("mock://sched", token=""),
             config_keeper=ck,
             cache_reader=cache_reader,
             running_task_keeper=running_keeper,
             pid_prober=pid_prober or (lambda pid: True),
         )
+        self._made.append(d)
+        return d
 
     def test_dispatch_and_complete(self, cluster):
         d = self._mk(cluster)
@@ -363,6 +374,7 @@ class TestHttpService:
         svc.start()
         yield svc
         svc.stop()
+        d.stop()
 
     def _ck(self):
         ck = ConfigKeeper("mock://sched", token="")

@@ -91,6 +91,47 @@ def test_grant_keeper_retires_idle_fetchers(monkeypatch):
         k.stop()
 
 
+def test_grant_keeper_thread_count_bounded_under_churn(monkeypatch):
+    """500 rotating compiler envs (the fleet-upgrade scenario the
+    idle-TTL exists for) must not accumulate fetcher threads: at any
+    instant the live `grant-fetch-*` population stays small, and
+    stop() joins the stragglers."""
+    import threading
+
+    from yadcc_tpu.daemon.local.task_grant_keeper import TaskGrantKeeper
+
+    k = TaskGrantKeeper("mock://nowhere", "")
+    monkeypatch.setattr(k, "_fetch", lambda *a, **kw: [])
+    monkeypatch.setattr(k, "_free_async", lambda ids: None)
+    monkeypatch.setattr(TaskGrantKeeper, "IDLE_FETCHER_TTL_S", 0.0)
+    baseline = {t.ident for t in threading.enumerate()
+                if t.name.startswith("grant-fetch-")}
+    peak = 0
+    try:
+        for i in range(500):
+            k.get(f"churn-env-{i}", timeout_s=0.0)
+            alive = sum(1 for t in threading.enumerate()
+                        if t.name.startswith("grant-fetch-")
+                        and t.ident not in baseline)
+            peak = max(peak, alive)
+        # Retired fetchers exit within ~one poll lap; with TTL=0 every
+        # get() retires the previous env's fetcher, so the live
+        # population is bounded by lap-time x churn-rate, not by the
+        # number of envs ever seen.
+        assert peak < 50, f"peak {peak} fetcher threads for 500 envs"
+    finally:
+        k.stop()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("grant-fetch-")
+                 and t.ident not in baseline]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, alive
+
+
 def test_cache_service_client_state_ttl():
     """Per-client Bloom sync state is TTL'd: a fleet of short-lived
     clients must not grow the map forever."""

@@ -256,6 +256,46 @@ class TestSchedulerService:
         return ch.call("ytpu.SchedulerService", "Heartbeat", req,
                        api.scheduler.HeartbeatResponse)
 
+    def test_min_daemon_version_gate(self):
+        """Version-ledger discipline (reference common_flags.cc:41-63):
+        a scheduler started with --min-daemon-version rejects heartbeats
+        from daemons older than the ledger floor, and accepts the
+        current VERSION_FOR_UPGRADE."""
+        from yadcc_tpu.version import VERSION_FOR_UPGRADE
+
+        clock = VirtualClock(100.0)
+        d = TaskDispatcher(GreedyCpuPolicy(), max_servants=16, max_envs=64,
+                           clock=clock, batch_window_s=0.0)
+        svc = SchedulerService(
+            d,
+            user_tokens=TokenVerifier(["user-tok"]),
+            servant_tokens=TokenVerifier(["servant-tok"]),
+            min_daemon_version=VERSION_FOR_UPGRADE,
+            clock=clock,
+        )
+        register_mock_server("sched-vgate", svc.spec())
+        try:
+            ch = Channel("mock://sched-vgate")
+            req = api.scheduler.HeartbeatRequest(
+                token="servant-tok", next_heartbeat_in_ms=1000,
+                version=VERSION_FOR_UPGRADE - 1, location="10.0.0.1:8335",
+                num_processors=16, capacity=8,
+                total_memory_in_bytes=1 << 30,
+                memory_available_in_bytes=1 << 30)
+            req.env_descs.add(compiler_digest=ENV)
+            with pytest.raises(RpcError) as ei:
+                ch.call("ytpu.SchedulerService", "Heartbeat", req,
+                        api.scheduler.HeartbeatResponse)
+            assert (ei.value.status
+                    == api.scheduler.SCHEDULER_STATUS_VERSION_TOO_OLD)
+            req.version = VERSION_FOR_UPGRADE
+            resp, _ = ch.call("ytpu.SchedulerService", "Heartbeat", req,
+                              api.scheduler.HeartbeatResponse)
+            assert len(resp.acceptable_tokens) == 3
+        finally:
+            unregister_mock_server("sched-vgate")
+            d.stop()
+
     def test_heartbeat_and_grant_flow(self, service):
         ch = Channel("mock://sched")
         resp, _ = self._beat(ch)

@@ -3,20 +3,54 @@
 # healthy, then run the full benchmark + artifact chain on the real
 # chip in one session.  The tunnel in this environment wedges
 # intermittently (hangs PJRT init with zero CPU); every stage below is
-# therefore under its own timeout, and a wedge just returns us to the
-# probe loop.  Usage: tools/tpu_capture.sh [max_wait_minutes]
+# therefore under its own timeout, and a wedge is treated as a bug to
+# recover from (kill stale holders, bounded re-init), not weather to
+# report (VERDICT r3 next-round #1).
+# Usage: tools/tpu_capture.sh [max_wait_minutes]
 set -u
 cd "$(dirname "$0")/.."
 MAX_MIN=${1:-360}
-PROBE_TIMEOUT=${PROBE_TIMEOUT:-300}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-180}
 BENCH_TIMEOUT=${BENCH_TIMEOUT:-1800}
 TOOL_TIMEOUT=${TOOL_TIMEOUT:-900}
 LOG=artifacts/tpu_capture.log
 mkdir -p artifacts
 deadline=$(( $(date +%s) + MAX_MIN * 60 ))
 
+# Single instance only.  Round 3 ran TWO capture loops concurrently;
+# on a one-chip pool, concurrent PJRT claims (each killed mid-init by
+# its probe timeout) leak unclaimed grants and can wedge every later
+# init.  flock makes a second invocation exit instead of competing.
+LOCK=/tmp/ytpu_capture.lock
+exec 9>"$LOCK"
+if ! flock -n 9; then
+  echo "$(date -Is) another capture loop is running; exiting" >> "$LOG"
+  exit 0
+fi
+
+# Leave the machine clean no matter how we exit: stray JAX-initialised
+# children are exactly what holds the TPU for the next session.
+trap 'bash tools/teardown.sh >/dev/null 2>&1' EXIT
+
+recover() {
+  # Kill anything of ours (other than this loop + its children) that
+  # might hold the accelerator tunnel: old entry processes, stray
+  # probes, leftover bench children.  Probe timeouts orphan PJRT
+  # clients; the pool only re-grants once the holder is gone.
+  local pids pid
+  pids=$(pgrep -f 'yadcc_tpu\.(scheduler|cache|daemon)\.entry' \
+         ; pgrep -f 'ytpu_probe_marker' \
+         ; pgrep -f 'BENCH_CHILD=1') || true
+  for pid in $pids; do
+    [ "$pid" = "$$" ] && continue
+    kill -9 "$pid" 2>/dev/null \
+      && echo "$(date -Is) recover: killed holder pid $pid" >> "$LOG"
+  done
+}
+
 probe() {
   timeout "$PROBE_TIMEOUT" python -u -c "
+# ytpu_probe_marker
 import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform == 'tpu', d
@@ -103,7 +137,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     fi
     echo "$(date -Is) bench attempt failed; back to probing" >> "$LOG"
   else
-    echo "$(date -Is) probe failed/wedged" >> "$LOG"
+    echo "$(date -Is) probe failed/wedged; recovering" >> "$LOG"
+    recover
   fi
   snooze 300
 done

@@ -30,6 +30,11 @@ class CacheEngine:
     def stats(self) -> Dict:
         return {}
 
+    def purge(self) -> None:
+        """Periodic maintenance pass (reference runs `cache_->Purge()`
+        on a 1-min timer, cache_service_impl.cc:172-180).  Engines with
+        no expiry/size maintenance keep the default no-op."""
+
     def stop(self) -> None:
         pass
 

@@ -66,6 +66,9 @@ class DiskCacheEngine(CacheEngine):
             "total_bytes": self._cache.total_bytes(),
         }
 
+    def purge(self) -> None:
+        self._cache.purge()
+
     # -- manifest --------------------------------------------------------------
 
     def _load_manifest(self) -> None:

@@ -55,6 +55,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "wrong shard after a topology change (reference "
                         "--disk_engine_action_on_misplaced_cache_entry)")
     p.add_argument("--l1-capacity", default="4G")
+    p.add_argument("--l1-ttl", type=float, default=4 * 3600.0,
+                   help="idle seconds before the 1-min purge timer "
+                        "expires an L1 entry (0 disables expiry)")
     p.add_argument("--acceptable-user-tokens", default="")
     p.add_argument("--acceptable-servant-tokens", default="")
     return p
@@ -96,6 +99,7 @@ def cache_server_start(args) -> None:
     service = CacheService(
         InMemoryCache(parse_size(args.l1_capacity)),
         l2,
+        l1_ttl_s=args.l1_ttl or float("inf"),
         user_tokens=make_token_verifier_from_flag(
             args.acceptable_user_tokens),
         servant_tokens=make_token_verifier_from_flag(
@@ -114,12 +118,17 @@ def cache_server_start(args) -> None:
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
-    last_rebuild = time.monotonic()
+    last_rebuild = last_purge = time.monotonic()
     while not stop.is_set():
         time.sleep(1.0)
         if time.monotonic() - last_rebuild >= 60.0:
             service.rebuild_bloom_filter()
             last_rebuild = time.monotonic()
+        # Separate 1-min purge timer beside the rebuild (reference
+        # cache_service_impl.cc:172-180 runs the two independently).
+        if time.monotonic() - last_purge >= 60.0:
+            service.purge()
+            last_purge = time.monotonic()
     server.stop()
     inspect.stop()
     l2.stop()

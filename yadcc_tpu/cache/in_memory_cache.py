@@ -16,11 +16,19 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.clock import REAL_CLOCK, Clock
+
 
 class InMemoryCache:
-    def __init__(self, capacity_bytes: int):
+    def __init__(self, capacity_bytes: int, *, clock: Clock = REAL_CLOCK):
         self._c = capacity_bytes
         self._p = 0  # adaptive target for T1 bytes
+        self._clock = clock
+        # key -> last get/put time; lets the purge timer expire entries
+        # by idleness instead of waiting for capacity pressure
+        # (reference runs cache purge on a 1-min timer,
+        # cache_service_impl.cc:172-180).
+        self._touched: Dict[str, float] = {}
         self._lock = threading.Lock()
         # key -> value bytes; OrderedDict: LRU at the front.
         self._t1: "OrderedDict[str, bytes]" = OrderedDict()
@@ -45,21 +53,43 @@ class InMemoryCache:
                 self._t1_bytes -= len(v)
                 self._t2[key] = v
                 self._t2_bytes += len(v)
+                self._touched[key] = self._clock.now()
                 self.hits += 1
                 return v
             v = self._t2.get(key)
             if v is not None:
                 self._t2.move_to_end(key)
+                self._touched[key] = self._clock.now()
                 self.hits += 1
                 return v
             self.misses += 1
             return None
+
+    def purge(self, ttl_s: float) -> int:
+        """Expire entries idle for longer than ``ttl_s``.  Unlike
+        capacity eviction this is a true expiry: victims do NOT enter
+        the ghost lists (a re-reference of an expired artifact is a
+        fresh compile, not evidence for tuning `p`).  Returns the
+        number of entries dropped."""
+        dropped = 0
+        with self._lock:
+            cutoff = self._clock.now() - ttl_s
+            for lst, attr in ((self._t1, "_t1_bytes"),
+                              (self._t2, "_t2_bytes")):
+                for key in [k for k in lst
+                            if self._touched.get(k, 0.0) < cutoff]:
+                    v = lst.pop(key)
+                    setattr(self, attr, getattr(self, attr) - len(v))
+                    self._touched.pop(key, None)
+                    dropped += 1
+        return dropped
 
     def put(self, key: str, value: bytes) -> None:
         size = len(value)
         if size > self._c:
             return  # larger than the whole cache: don't thrash
         with self._lock:
+            self._touched[key] = self._clock.now()
             # Case: resident — update in place, treat as a frequency hit.
             old = self._t1.pop(key, None)
             if old is not None:
@@ -120,6 +150,7 @@ class InMemoryCache:
 
     def remove(self, key: str) -> bool:
         with self._lock:
+            self._touched.pop(key, None)
             for lst, attr in ((self._t1, "_t1_bytes"), (self._t2, "_t2_bytes")):
                 v = lst.pop(key, None)
                 if v is not None:
@@ -168,11 +199,13 @@ class InMemoryCache:
             if from_t1:
                 k, v = self._t1.popitem(last=False)
                 self._t1_bytes -= len(v)
+                self._touched.pop(k, None)
                 self._b1[k] = len(v)
                 self._b1_bytes += len(v)
             elif self._t2:
                 k, v = self._t2.popitem(last=False)
                 self._t2_bytes -= len(v)
+                self._touched.pop(k, None)
                 self._b2[k] = len(v)
                 self._b2_bytes += len(v)
             else:

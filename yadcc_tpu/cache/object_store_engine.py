@@ -181,6 +181,15 @@ class ObjectStoreEngine(CacheEngine):
     def resync_for_testing(self) -> None:
         self._resync()
 
+    def purge(self) -> None:
+        """Periodic maintenance (CacheService's 1-min purge timer):
+        resync bookkeeping with the store, then trim to capacity —
+        covers objects written by other cache servers sharing the
+        bucket, which the write-path purge never sees."""
+        self._resync()
+        with self._lock:
+            self._purge_locked()
+
     def stats(self) -> Dict:
         with self._lock:
             return {"objects": len(self._sizes),

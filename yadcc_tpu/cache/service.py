@@ -43,6 +43,12 @@ _FULL_FETCH_INTERVAL_S = 600.0
 _FULL_FETCH_JITTER_S = 120.0
 # Per-client sync records are dropped after this idle time.
 _CLIENT_STATE_TTL_S = 2 * _MAX_INCREMENTAL_AGE_S
+# L1 entries idle this long are expired by the purge timer (reference
+# purges on a 1-min cadence, cache_service_impl.cc:172-180; its L1
+# expiry is capacity-driven — ours adds an idleness TTL so a quiet
+# server releases memory instead of pinning every artifact it ever
+# served until capacity pressure arrives).
+DEFAULT_L1_TTL_S = 4 * 3600.0
 
 
 class CacheService:
@@ -54,9 +60,12 @@ class CacheService:
         user_tokens: TokenVerifier = TokenVerifier(),
         servant_tokens: TokenVerifier = TokenVerifier(),
         clock: Clock = REAL_CLOCK,
+        l1_ttl_s: float = DEFAULT_L1_TTL_S,
     ):
         self.l1 = l1
         self.l2 = l2
+        self._l1_ttl_s = l1_ttl_s
+        self._purged_total = 0
         self.bloom = BloomFilterGenerator(clock=clock)
         self._user_tokens = user_tokens
         self._servant_tokens = servant_tokens
@@ -83,6 +92,18 @@ class CacheService:
         """60s-cadence timer body (and startup)."""
         keys = set(self.l2.keys()) | set(self.l1.keys())
         self.bloom.rebuild(keys)
+
+    def purge(self) -> None:
+        """1-min-cadence timer body (reference
+        cache_service_impl.cc:172-180): expire idle L1 entries and run
+        the L2 engine's maintenance pass.  Without this, L1 entries age
+        out only under capacity pressure."""
+        dropped = self.l1.purge(self._l1_ttl_s)
+        self.l2.purge()
+        if dropped:
+            self._purged_total += dropped
+            logger.info("purged %d idle L1 entries (ttl=%.0fs)",
+                        dropped, self._l1_ttl_s)
 
     # -- handlers ----------------------------------------------------------
 
@@ -209,5 +230,6 @@ class CacheService:
             "l2": {"engine": self.l2.name, **self.l2.stats()},
             "l2_hits": self._l2_hits,
             "fills": self._fills,
+            "l1_purged": self._purged_total,
             "bloom_fill_ratio": round(self.bloom.fill_ratio(), 6),
         }

@@ -176,6 +176,16 @@ class DiskCache:
                 s: (len(self._entries[s]), self._sizes[s]) for s in self._shards
             }
 
+    def purge(self) -> None:
+        """Periodic maintenance: trim every shard back to capacity.
+        The write path already purges the shard it touches; this pass
+        covers shards whose capacity was reduced (restart with a
+        smaller --cache-dirs quota) or that were filled by the startup
+        scan rather than writes."""
+        with self._lock:
+            for shard in self._shards:
+                self._purge_locked(shard)
+
     # -- internals ---------------------------------------------------------
 
     def _purge_locked(self, shard: str) -> None:

@@ -89,6 +89,16 @@ class DistributedTaskDispatcher:
 
     # -- public API ----------------------------------------------------------
 
+    def stop(self) -> None:
+        """Ordered shutdown: stop the grant keeper (joins its fetcher
+        threads — without this every keeper leaks one `grant-fetch-*`
+        thread per compiler env for the process lifetime) and the
+        cache reader's refresh loop.  In-flight task threads are
+        daemonic and finish or die with the process."""
+        self._grants.stop()
+        if self._cache is not None and hasattr(self._cache, "stop"):
+            self._cache.stop()
+
     def queue_task(self, task: DistributedTask) -> int:
         with self._lock:
             entry = _Entry(task_id=self._next_id, task=task)

@@ -25,6 +25,15 @@ logger = get_logger("daemon.grant_keeper")
 
 _LEASE_S = 15.0
 _NETWORK_TOLERANCE_S = 5.0
+# Long-poll lap length.  The reference issues one 5s poll per demand
+# window; we split it into short laps so a fetcher observes retire()/
+# stop() within one lap instead of lingering in a blocked RPC for the
+# whole poll (the round-3 thread leak: retired fetchers survived ~8s
+# past retirement, unbounded under compiler-env churn).  A scheduler
+# with grants available answers a lap instantly, so throughput is
+# unaffected; only the dry-scheduler case polls more often.
+_POLL_LAP_MS = 1000
+_RPC_TIMEOUT_MARGIN_S = 1.5
 
 
 @dataclass
@@ -92,11 +101,15 @@ class _EnvFetcher:
         if stale:
             self.keeper._free_async(stale)
 
+    def _stopped(self) -> bool:
+        return (self.keeper._stopping.is_set() or self.retired.is_set())
+
     def _loop(self) -> None:
-        while not (self.keeper._stopping.is_set()
-                   or self.retired.is_set()):
+        while not self._stopped():
             self.wake.wait(timeout=0.5)
             self.wake.clear()
+            if self._stopped():
+                break
             with self.lock:
                 waiters = self.waiters
             backlog = self.queue.qsize()
@@ -111,8 +124,8 @@ class _EnvFetcher:
                     gid, location,
                     usable_until=now + _LEASE_S - _NETWORK_TOLERANCE_S))
             if not grants:
-                time.sleep(0.1)  # scheduler dry: don't hammer it
-        if self.retired.is_set():
+                self.retired.wait(0.1)  # scheduler dry: don't hammer it
+        if self.retired.is_set() or self.keeper._stopping.is_set():
             # A fetch that was in flight when retire() drained may have
             # enqueued grants after that drain: free them too, or the
             # scheduler holds those slots until the lease expires.
@@ -174,8 +187,23 @@ class TaskGrantKeeper:
             logger.warning("KeepTaskAlive failed: %s", e)
             return [False] * len(list(grant_ids))
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        """Stop all fetchers and wait (bounded) for their threads to
+        exit.  Joining matters: a fetcher blocked in its long-poll lap
+        exits within ~one lap, and callers (daemon shutdown, tests)
+        must not strand live `grant-fetch-*` threads behind them."""
         self._stopping.set()
+        with self._lock:
+            fetchers = list(self._fetchers.values())
+            self._fetchers.clear()
+        for f in fetchers:
+            f.retired.set()
+            f.wake.set()
+        deadline = time.monotonic() + join_timeout_s
+        for f in fetchers:
+            f.thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        for f in fetchers:
+            f._drain_and_free()
 
     # -- internals -----------------------------------------------------------
 
@@ -188,7 +216,7 @@ class TaskGrantKeeper:
     def _fetch(self, env_digest: str, immediate: int, prefetch: int):
         req = api.scheduler.WaitForStartingTaskRequest(
             token=self._token,
-            milliseconds_to_wait=5000,
+            milliseconds_to_wait=_POLL_LAP_MS,
             immediate_reqs=immediate,
             prefetch_reqs=prefetch,
             next_keep_alive_in_ms=int(_LEASE_S * 1000),
@@ -198,7 +226,8 @@ class TaskGrantKeeper:
         try:
             resp, _ = self._chan().call(
                 "ytpu.SchedulerService", "WaitForStartingTask", req,
-                api.scheduler.WaitForStartingTaskResponse, timeout=8.0)
+                api.scheduler.WaitForStartingTaskResponse,
+                timeout=_POLL_LAP_MS / 1000.0 + _RPC_TIMEOUT_MARGIN_S)
             return [(g.task_grant_id, g.servant_location)
                     for g in resp.grants]
         except RpcError:

@@ -226,6 +226,7 @@ class LocalCluster:
 
     def stop(self):
         self.http.stop()
+        self.delegate.stop()  # joins its grant keeper's fetcher threads
         for k in self._extra_keepers:
             k.stop()
         self.running_keeper.stop()

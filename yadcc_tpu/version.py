@@ -7,9 +7,15 @@ pool.  Bump on every wire-visible change and record it here.
 
 History:
   1: initial wire protocol of the TPU-native rebuild.
+  2: cache_control=2 carries Refill semantics end to end (daemon.proto
+     disable_cache_fill / local.proto cache-control tri-state) and
+     local.proto's ignore-timestamp-macros knob joins the task
+     submission surface.  Consolidates the two wire-visible additions
+     that landed without a bump (commits 796867e, f6c2572) — recorded
+     retroactively per VERDICT r3 "version-ledger discipline".
 """
 
-VERSION_FOR_UPGRADE = 1
+VERSION_FOR_UPGRADE = 2
 
 # Human-readable build stamp served by /local/get_version.
 BUILT_AT = "yadcc-tpu dev"
