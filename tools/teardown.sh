@@ -12,10 +12,23 @@
 # script paths only).
 set -u
 
-# Our own ancestry must survive: never kill ourselves, our parents,
-# or the agent driving us.
+# This checkout's root: only processes running from (or referencing)
+# this path are considered ours.  A bare substring match like
+# `bench.py` would also hit an editor or an unrelated project's
+# script of the same name (advisor r4, medium).
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+
+# Our own ancestry must survive: never kill ourselves, any parent up
+# the chain, or the agent driving us.  $PPID alone is not enough —
+# the driving agent is usually a grandparent.
 SELF=$$
-KEEP="$SELF $PPID"
+KEEP="$SELF"
+p=$SELF
+while [ "$p" -gt 1 ] 2>/dev/null; do
+  p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || break
+  [ -n "$p" ] || break
+  KEEP="$KEEP $p"
+done
 
 is_kept() {
   local pid
@@ -25,12 +38,24 @@ is_kept() {
   return 1
 }
 
+is_ours() {
+  # A pattern hit is only ours if the process runs from this checkout
+  # (cwd under $REPO) or its command line names this checkout's path.
+  local cwd
+  cwd=$(readlink "/proc/$1/cwd" 2>/dev/null) && \
+    case "$cwd" in "$REPO"|"$REPO"/*) return 0 ;; esac
+  tr '\0' ' ' < "/proc/$1/cmdline" 2>/dev/null | grep -qF "$REPO" && \
+    return 0
+  return 1
+}
+
 kill_matching() {
-  # $1: pgrep -f pattern
+  # $1: pgrep -f pattern (further scoped by is_ours)
   local pids pid
   pids=$(pgrep -f "$1" 2>/dev/null) || return 0
   for pid in $pids; do
     is_kept "$pid" && continue
+    is_ours "$pid" || continue
     kill "$pid" 2>/dev/null
   done
   # Grace, then force anything still alive.
@@ -38,6 +63,7 @@ kill_matching() {
   pids=$(pgrep -f "$1" 2>/dev/null) || return 0
   for pid in $pids; do
     is_kept "$pid" && continue
+    is_ours "$pid" || continue
     kill -9 "$pid" 2>/dev/null
   done
 }
@@ -45,7 +71,7 @@ kill_matching() {
 kill_matching 'yadcc_tpu\.(scheduler|cache|daemon)\.entry'
 kill_matching 'yadcc_tpu\.tools\.'
 kill_matching 'tools/tpu_capture\.sh'
-kill_matching 'bench\.py'
+kill_matching 'python[^ ]* (-u )?(-m )?.*bench\.py'
 kill_matching 'ytpu_probe_marker'
 
 exit 0

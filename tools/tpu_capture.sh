@@ -33,23 +33,36 @@ fi
 trap 'bash tools/teardown.sh >/dev/null 2>&1' EXIT
 
 recover() {
-  # Kill anything of ours (other than this loop + its children) that
-  # might hold the accelerator tunnel: old entry processes, stray
+  # Kill anything of ours (other than this loop's own process group)
+  # that might hold the accelerator tunnel: old entry processes, stray
   # probes, leftover bench children.  Probe timeouts orphan PJRT
   # clients; the pool only re-grants once the holder is gone.
-  local pids pid
+  # Scoped two ways (advisor r4): skip our own process group, and
+  # only touch processes running from this checkout — a cluster or
+  # daemon legitimately started elsewhere is not ours to kill.
+  local pids pid mypg pg cwd
+  mypg=$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')
   pids=$(pgrep -f 'yadcc_tpu\.(scheduler|cache|daemon)\.entry' \
          ; pgrep -f 'ytpu_probe_marker' \
          ; pgrep -f 'BENCH_CHILD=1') || true
   for pid in $pids; do
     [ "$pid" = "$$" ] && continue
+    pg=$(ps -o pgid= -p "$pid" 2>/dev/null | tr -d ' ')
+    [ -n "$mypg" ] && [ "$pg" = "$mypg" ] && continue
+    cwd=$(readlink "/proc/$pid/cwd" 2>/dev/null) || cwd=
+    case "$cwd" in "$PWD"|"$PWD"/*) ;; *) continue ;; esac
     kill -9 "$pid" 2>/dev/null \
       && echo "$(date -Is) recover: killed holder pid $pid" >> "$LOG"
   done
 }
 
 probe() {
-  timeout "$PROBE_TIMEOUT" python -u -c "
+  # -k: a PJRT init wedged in uninterruptible claim retry can ignore
+  # the default TERM; force KILL 10s later so the pgid-spare in
+  # recover() never needs to reap our own probe children.
+  # nice 19: this box is single-core; a probe's jax import must never
+  # steal cycles from a latency benchmark running concurrently.
+  timeout -k 10 "$PROBE_TIMEOUT" nice -n 19 python -u -c "
 # ytpu_probe_marker
 import jax, jax.numpy as jnp
 d = jax.devices()
