@@ -363,9 +363,17 @@ def main() -> None:
     disp_pipe_per_sec = None if headline_only \
         else _dispatcher_pipelined_throughput()
     beats_per_sec = None if headline_only else _heartbeat_throughput()
+    bloom_fp = None if headline_only else _bloom_fingerprint_metrics()
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 2 (r06+): the pipelined harness drains at
+        # len(inflight) >= window (was >), so `pipeline_window` is the
+        # true cap on in-flight batches.  r01-r05 artifacts measured
+        # one extra batch in flight at the same nominal window — do
+        # not compare r06+ numbers against them at equal window
+        # settings without accounting for that.
+        "harness_version": 2,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -391,6 +399,7 @@ def main() -> None:
         "dispatcher_grants_per_sec": disp_per_sec,
         "dispatcher_pipelined_grants_per_sec": disp_pipe_per_sec,
         "heartbeats_per_sec": beats_per_sec,
+        "bloom_fingerprint_mkeys_per_sec": bloom_fp,
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),
@@ -426,6 +435,38 @@ def main() -> None:
             result["pallas_grouped_ab"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
         print(json.dumps(result), flush=True)
+
+
+def _bloom_fingerprint_metrics(n: int = 1_000_000) -> dict:
+    """Mkeys/s of host cache-key fingerprinting (the Bloom control
+    plane's hashing budget, BASELINE configs[3] prep): the r02 per-key
+    C-call loop vs the vectorized pack+digest that replaced it.  The
+    loop baseline runs on an n/8 subsample (it is the slow side and
+    its cost is linear); see yadcc_tpu/tools/bloom_bench.py for the
+    full three-way sweep with probe timings."""
+    from yadcc_tpu.common import bloom
+    from yadcc_tpu.common.xxh64_np import pack_key_matrix, xxh64_grouped
+
+    keys = [f"ytpu-cxx2-entry-{i:07d}" for i in range(n)]
+    m = max(1, n // 8)
+    t0 = time.perf_counter()
+    bloom.key_fingerprints_loop(keys[:m], 17)
+    t_loop = (time.perf_counter() - t0) * (n / m)
+    t0 = time.perf_counter()
+    mat, lens = pack_key_matrix(keys)
+    t_pack = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    bloom._split_digests(xxh64_grouped(mat, lens, 17))
+    t_vec = time.perf_counter() - t0
+    return {
+        "batch_keys": n,
+        "host_loop": round(n / t_loop / 1e6, 2),
+        "host_vectorized_digest": round(n / t_vec / 1e6, 2),
+        "host_vectorized_end_to_end": round(n / (t_pack + t_vec) / 1e6,
+                                            2),
+        "speedup_digest": round(t_loop / t_vec, 1),
+        "speedup_end_to_end": round(t_loop / (t_pack + t_vec), 1),
+    }
 
 
 def _heartbeat_throughput(n_servants: int = 5000, n: int = 10000) -> float:

@@ -1,0 +1,236 @@
+"""Vectorized/fused Bloom fingerprint pipeline: bit-exactness suite.
+
+Three layers, each checked against the layer below it:
+
+  1. common/xxh64_np.py   — lane-parallel numpy XXH64 vs the C `xxhash`
+     wheel, over every tail-length class (0-31 byte tails, >=32-byte
+     stripes), seeds, chunk boundaries, and variable-length batches;
+  2. common/bloom.py      — vectorized fingerprints / batched filter
+     ops vs the scalar key_fingerprint / add / may_contain path;
+  3. ops/bloom_pipeline.py + parallel/mesh.py — the fused device
+     digest→split→probe kernel (single-device and filter-sharded on
+     the virtual 8-device mesh) vs host membership.
+
+Membership parity is asserted on mixed-length key batches spanning
+member AND absent keys — a kernel that admits everything must fail.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import xxhash
+
+from yadcc_tpu.common import bloom, xxh64_np
+
+
+class TestXxh64Batch:
+    def test_every_tail_length_class(self):
+        """Lengths 0..34 cover every tail combination (u64 words, the
+        u32 read, single bytes) plus the first stripe; 63/64/65 and
+        200 cover multi-stripe and stripe-boundary keys."""
+        rng = np.random.default_rng(7)
+        for length in list(range(0, 35)) + [63, 64, 65, 100, 200]:
+            mat = rng.integers(0, 256, (13, length), dtype=np.uint8)
+            for seed in (0, 17, 2**32 - 1, 2**63, 2**64 - 1):
+                got = xxh64_np.xxh64_batch(mat, seed)
+                want = np.array(
+                    [xxhash.xxh64_intdigest(mat[i].tobytes(), seed=seed)
+                     for i in range(mat.shape[0])], np.uint64)
+                assert np.array_equal(got, want), (length, seed)
+
+    def test_chunk_boundaries(self, monkeypatch):
+        """Rows digest identically wherever the cache-chunking splits
+        them (shrunk chunk size so the test stays fast)."""
+        monkeypatch.setattr(xxh64_np, "_CHUNK_ROWS", 8)
+        rng = np.random.default_rng(3)
+        mat = rng.integers(0, 256, (37, 23), dtype=np.uint8)
+        got = xxh64_np.xxh64_batch(mat, 5)
+        want = np.array([xxhash.xxh64_intdigest(mat[i].tobytes(), seed=5)
+                         for i in range(37)], np.uint64)
+        assert np.array_equal(got, want)
+
+    def test_stated_length_in_wider_zero_padded_matrix(self):
+        """The pack_key_matrix layout: rows wider than the key, zero
+        tail, digest of the stated length only."""
+        rng = np.random.default_rng(4)
+        mat = np.zeros((9, 24), np.uint8)
+        mat[:, :23] = rng.integers(0, 256, (9, 23), dtype=np.uint8)
+        got = xxh64_np.xxh64_batch(mat, 11, 23)
+        want = np.array(
+            [xxhash.xxh64_intdigest(mat[i, :23].tobytes(), seed=11)
+             for i in range(9)], np.uint64)
+        assert np.array_equal(got, want)
+
+    def test_variable_length_keys_including_nuls(self):
+        rng = np.random.default_rng(9)
+        keys = [bytes(rng.integers(0, 256, int(n)))
+                for n in rng.integers(0, 90, 300)]
+        keys += [b"", b"x", b"tail\x00", b"emb\x00ed", b"\x00" * 8,
+                 b"q" * 200]
+        got = xxh64_np.xxh64_keys(keys, 42)
+        want = np.array([xxhash.xxh64_intdigest(k, seed=42)
+                         for k in keys], np.uint64)
+        assert np.array_equal(got, want)
+
+    def test_str_keys_ascii_and_unicode(self):
+        keys = ["", "a", "ytpu-cxx2-entry-000", "é-unicode", "x" * 40,
+                "nul\x00tail"]
+        got = xxh64_np.xxh64_keys(keys, 3)
+        want = np.array([xxhash.xxh64_intdigest(k.encode(), seed=3)
+                         for k in keys], np.uint64)
+        assert np.array_equal(got, want)
+
+    def test_pack_key_matrix_layout(self):
+        keys = [b"abc", b"longer-key!", b""]
+        mat, lengths = xxh64_np.pack_key_matrix(keys)
+        assert mat.shape[1] % 8 == 0
+        assert list(lengths) == [3, 11, 0]
+        for i, k in enumerate(keys):
+            assert mat[i, :len(k)].tobytes() == k
+            assert not mat[i, len(k):].any()  # zero tail
+
+
+class TestVectorizedFingerprints:
+    MIXED = (["k" + "x" * (i % 67) + str(i) for i in range(257)]
+             + ["", "a", "ab" * 40, "tail\x00", "emb\x00ed"])
+
+    def test_matches_scalar_above_and_below_crossover(self):
+        for salt in (0, 17, 0xDEADBEEF):
+            want = np.array([bloom.key_fingerprint(k, salt)
+                             for k in self.MIXED], np.uint32)
+            assert np.array_equal(
+                bloom.key_fingerprints(self.MIXED, salt), want)
+            small = self.MIXED[:bloom.VECTORIZE_MIN_KEYS - 1]
+            assert np.array_equal(
+                bloom.key_fingerprints(small, salt), want[:len(small)])
+            assert np.array_equal(
+                bloom.key_fingerprints_loop(self.MIXED, salt), want)
+
+    def test_filter_batched_ops_match_scalar(self):
+        f_batch = bloom.SaltedBloomFilter(num_bits=100003, num_hashes=7,
+                                          salt=42)
+        f_scalar = bloom.SaltedBloomFilter(num_bits=100003, num_hashes=7,
+                                           salt=42)
+        f_batch.add_many(self.MIXED)
+        for k in self.MIXED:
+            f_scalar.add(k)
+        assert np.array_equal(f_batch.words, f_scalar.words)
+        probe = self.MIXED + [f"absent-{i}" for i in range(300)]
+        want = np.array([f_scalar.may_contain(k) for k in probe])
+        assert want[:len(self.MIXED)].all()
+        assert not want.all()  # absent keys must exercise the False arm
+        assert np.array_equal(f_batch.may_contain_batch(probe), want)
+
+    def test_empty_batches(self):
+        f = bloom.SaltedBloomFilter(num_bits=1009, num_hashes=3, salt=1)
+        f.add_many([])
+        assert f.fill_ratio() == 0.0
+        assert f.may_contain_batch([]).shape == (0,)
+        assert bloom.key_fingerprints([], 5).shape == (0, 2)
+
+
+class TestFusedDevicePipeline:
+    @pytest.fixture(scope="class")
+    def filt(self):
+        f = bloom.SaltedBloomFilter(num_bits=999983, num_hashes=10,
+                                    salt=0xABCD1234)
+        f.add_many([f"ytpu-cxx2-entry-{i:05d}" for i in range(2000)])
+        return f
+
+    @pytest.fixture(scope="class")
+    def probe_keys(self):
+        # A handful of length classes (each class jit-compiles the
+        # fused kernel once for its static length — dozens would turn
+        # this into a compile benchmark), spanning tails, the u32
+        # read, and both sides of the 32-byte stripe boundary.
+        return ([f"ytpu-cxx2-entry-{i:05d}" for i in range(500)]
+                + [f"absent-{'y' * (i % 4)}{i % 10}" for i in range(400)]
+                + ["", "a", "abcd", "abcdefg", "x" * 32, "x" * 33])
+
+    def test_fused_matches_host_membership(self, filt, probe_keys):
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops.bloom_pipeline import bloom_membership_batch
+
+        got = bloom_membership_batch(
+            jnp.asarray(filt.words), probe_keys, filt.salt,
+            num_bits=filt.num_bits, num_hashes=filt.num_hashes)
+        want = filt.may_contain_batch(probe_keys)
+        scalar = np.array([filt.may_contain(k) for k in probe_keys])
+        assert np.array_equal(want, scalar)
+        assert got[:500].all() and not got.all()
+        assert np.array_equal(got, want)
+
+    def test_single_jitted_call_uniform_batch(self, filt):
+        """The no-round-trip contract: raw packed bytes in, bool out of
+        ONE jitted kernel."""
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops.bloom_pipeline import (
+            bloom_membership_from_keys, seed_pair)
+        from yadcc_tpu.ops.xxh64_jax import pack_keys
+
+        keys = [f"ytpu-cxx2-entry-{i:05d}".encode() for i in range(64)]
+        keys += [f"ytpu-cxx2-absnt-{i:05d}".encode() for i in range(64)]
+        packed = jnp.asarray(pack_keys(keys, 21))
+        got = np.asarray(bloom_membership_from_keys(
+            filt.words if not hasattr(filt.words, "device") else
+            jnp.asarray(filt.words), packed, 21, seed_pair(filt.salt),
+            num_bits=filt.num_bits, num_hashes=filt.num_hashes))
+        want = np.array([filt.may_contain(k.decode()) for k in keys])
+        assert got[:64].all() and not got.all()
+        assert np.array_equal(got, want)
+
+    def test_pack_key_buckets_round_trip(self):
+        from yadcc_tpu.ops.bloom_pipeline import pack_key_buckets
+
+        keys = ["abc", "defgh", "ij", "klm", ""]
+        seen = {}
+        for length, idxs, packed in pack_key_buckets(keys):
+            rows = np.asarray(packed).view(np.uint8)
+            if isinstance(idxs, slice):
+                idxs = range(len(keys))
+            for row, i in zip(rows, idxs):
+                seen[i] = row[:length].tobytes().decode()
+        assert seen == {i: k for i, k in enumerate(keys)}
+
+    @pytest.mark.parametrize("mesh_shape", ["1d", "2d"])
+    def test_sharded_fused_parity(self, filt, mesh_shape):
+        """The filter-sharded fused kernel on the virtual 8-device mesh
+        (1-level and 2-level) agrees with host membership."""
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops.bloom_pipeline import seed_pair
+        from yadcc_tpu.ops.xxh64_jax import pack_keys
+        from yadcc_tpu.parallel import mesh as pmesh
+
+        mesh = (pmesh.make_mesh(8) if mesh_shape == "1d"
+                else pmesh.make_mesh_2d(2, 4))
+        keys = ([f"ytpu-cxx2-entry-{i:05d}" for i in range(96)]
+                + [f"ytpu-cxx2-absnt-{i:05d}" for i in range(96)])
+        length = 21
+        packed = jnp.asarray(pack_keys([k.encode() for k in keys],
+                                       length))
+        fn = pmesh.sharded_bloom_membership_fn(
+            mesh, length=length, num_bits=filt.num_bits,
+            num_hashes=filt.num_hashes)
+        wpad = pmesh.bloom_words_padded(filt.words, mesh, filt.num_bits)
+        got = np.asarray(fn(jnp.asarray(wpad), packed,
+                            seed_pair(filt.salt)))
+        want = filt.may_contain_batch(keys)
+        assert got[:96].all() and not got.all()
+        assert np.array_equal(got, want)
+
+    def test_device_replica_uses_fused_path(self, filt):
+        from yadcc_tpu.cache.bloom_filter_generator import (
+            DeviceBloomReplica)
+
+        rep = DeviceBloomReplica(filt.to_bytes(), filt.num_hashes,
+                                 filt.salt, num_bits=filt.num_bits)
+        probe = ([f"ytpu-cxx2-entry-{i:05d}" for i in range(40)]
+                 + [f"nope-{i}" for i in range(40)])
+        got = rep.may_contain_batch(probe)
+        want = filt.may_contain_batch(probe)
+        assert np.array_equal(got, want)
+        assert rep.may_contain_batch([]).shape == (0,)

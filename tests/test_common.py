@@ -282,4 +282,6 @@ def test_bloom_bench_run_smoke():
     for s in out["sweep"]:
         # Observed positive rate ~ requested hit rate (+ FP noise).
         assert s["observed_positive_rate"] >= s["hit_rate"] - 0.01
-        assert s["keys_per_sec"] > 0
+        for path in ("host_loop", "host_vectorized", "device_fused"):
+            assert s[path]["keys_per_sec"] > 0
+        assert s["fingerprint_speedup_vec_vs_loop"] > 0

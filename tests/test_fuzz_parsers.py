@@ -76,7 +76,10 @@ def test_hostile_declared_content_size_rejected():
     BEFORE any allocation: python-zstandard's max_output_size does not
     bind frames that declare a content size, so the cap is enforced on
     the declared size itself."""
-    import zstandard
+    import pytest
+
+    zstandard = pytest.importorskip(
+        "zstandard")  # the zlib fallback has its own cap test below
 
     from yadcc_tpu.common.compress import decompress
 
@@ -87,6 +90,30 @@ def test_hostile_declared_content_size_rejected():
     with pytest.raises(zstandard.ZstdError):
         decompress(big, max_output_size=1 << 20)
     assert decompress(big, max_output_size=128 << 20) == b"\x00" * (64 << 20)
+
+
+def test_zlib_fallback_output_cap_and_roundtrip():
+    """The zstd-less stand-in must enforce the same decompressed-size
+    cap (declared-size frames and streaming frames both) and round-trip
+    cleanly — it is the live wire format on minimal containers."""
+    import pytest
+
+    from yadcc_tpu.common import _zlib_frames as zf
+
+    payload = b"\x00" * (8 << 20)
+    blob = zf.compress(payload)
+    assert zf.frame_content_size(blob) == len(payload)
+    assert zf.decompress(blob, 16 << 20) == payload
+    with pytest.raises(zf.Error):
+        zf.decompress(blob, 1 << 20)
+
+    # Streaming frame: unknown declared size, cap still binds.
+    sc = zf.StreamCompressor()
+    stream = sc.compress(payload) + sc.flush()
+    assert zf.frame_content_size(stream) == -1
+    assert zf.decompress(stream, 16 << 20) == payload
+    with pytest.raises(zf.Error):
+        zf.decompress(stream, 1 << 20)
 
 
 def test_keyed_buffer_unpacker_never_raises():

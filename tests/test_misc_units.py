@@ -138,6 +138,11 @@ class TestProtoGeneration:
         """build_protos must reproduce the checked-in gen/ exactly —
         drift between .proto sources and generated stubs is a silent
         wire break."""
+        import shutil
+
+        if shutil.which("protoc") is None:
+            pytest.skip("protoc not installed (gen/ stubs are "
+                        "checked in; runtime never needs it)")
         before = {}
         gen = REPO / "yadcc_tpu" / "api" / "gen"
         for p in gen.glob("*_pb2.py"):

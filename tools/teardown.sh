@@ -20,12 +20,15 @@ REPO=$(cd "$(dirname "$0")/.." && pwd)
 
 # Our own ancestry must survive: never kill ourselves, any parent up
 # the chain, or the agent driving us.  $PPID alone is not enough —
-# the driving agent is usually a grandparent.
+# the driving agent is usually a grandparent.  Parse PPid: from
+# /proc/$p/status: field 4 of /proc/$p/stat is NOT the ppid when the
+# comm name contains spaces (e.g. "tmux: server"), and a misparse
+# here walks a wrong chain and leaves real ancestors unprotected.
 SELF=$$
 KEEP="$SELF"
 p=$SELF
 while [ "$p" -gt 1 ] 2>/dev/null; do
-  p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || break
+  p=$(awk '/^PPid:/{print $2}' "/proc/$p/status" 2>/dev/null) || break
   [ -n "$p" ] || break
   KEEP="$KEEP $p"
 done

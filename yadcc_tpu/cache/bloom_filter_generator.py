@@ -92,13 +92,16 @@ class BloomFilterGenerator:
         """
         fresh = bloom.SaltedBloomFilter(self._num_bits, self._num_hashes,
                                         self._salt)
-        for k in keys:
-            fresh.add(k)
+        # Batched insert: one vectorized fingerprint pass over the whole
+        # enumeration instead of a per-key digest call (the rebuild is
+        # the server's biggest hashing burst — 1M keys at ~870ns/key of
+        # C-call overhead was 0.87s of pure fingerprinting).
+        fresh.add_many(keys if isinstance(keys, (list, tuple))
+                       else list(keys))
         now = self._clock.now()
         with self._lock:
             self._trim_locked(now)
-            for _, k in self._new_keys:
-                fresh.add(k)
+            fresh.add_many([k for _, k in self._new_keys])
             self._filter = fresh
 
     def filter_bytes(self) -> bytes:
@@ -137,17 +140,17 @@ class DeviceBloomReplica:
         self._salt = salt
 
     def may_contain_batch(self, keys: List[str]):
-        """bool numpy array [len(keys)] via one device call."""
-        import jax.numpy as jnp
+        """bool numpy array [len(keys)] via the fused fingerprint→probe
+        pipeline: raw key bytes go up, membership comes back — the
+        fingerprint never exists on the host (ops/bloom_pipeline.py;
+        round-2's 0.87s/1M-key host hashing ahead of an 0.08s probe)."""
         import numpy as np
 
-        from ..ops.bloom_probe import bloom_may_contain
+        from ..ops.bloom_pipeline import bloom_membership_batch
 
         if not keys:
             return np.zeros(0, bool)
-        fps = bloom.key_fingerprints(keys, self._salt)
-        out = bloom_may_contain(
-            self._words_dev, jnp.asarray(fps),
+        return bloom_membership_batch(
+            self._words_dev, keys, self._salt,
             num_bits=self._host.num_bits,
             num_hashes=self._host.num_hashes)
-        return np.asarray(out)

@@ -1,0 +1,101 @@
+"""zlib-framed stand-in for the zstd helpers on hosts without the
+`zstandard` wheel (see common/compress.py for the gating story).
+
+Two frame kinds, distinguished by a 4-byte magic so the size-cap
+check in compress.decompress keeps working:
+
+  * one-shot  — ``YZF1`` + u64le declared size + zlib stream
+    (frame_content_size reads the declared size, like a zstd frame
+    header with content size set);
+  * streaming — ``YZFS`` + zlib stream (declared size unknown, -1,
+    like a zstd streaming frame).
+
+Pure stdlib; never imported when the real wheel is present.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+_ONE_SHOT_MAGIC = b"YZF1"
+_STREAM_MAGIC = b"YZFS"
+
+
+class Error(Exception):
+    """Stands in for zstandard.ZstdError in except clauses."""
+
+
+def compress(data: bytes, level: int = 3) -> bytes:
+    return (_ONE_SHOT_MAGIC + len(data).to_bytes(8, "little")
+            + zlib.compress(data, level))
+
+
+def frame_content_size(data: bytes) -> int:
+    """Declared decompressed size; -1 for streaming frames.  Raises on
+    anything that isn't one of our frames — same contract as
+    zstandard.frame_content_size on a malformed header."""
+    if data[:4] == _ONE_SHOT_MAGIC and len(data) >= 12:
+        return int.from_bytes(data[4:12], "little")
+    if data[:4] == _STREAM_MAGIC:
+        return -1
+    raise Error("not a framed payload")
+
+
+def decompress(data: bytes, max_output_size: int) -> bytes:
+    declared = frame_content_size(data)        # raises on bad magic
+    body = data[12:] if declared >= 0 else data[4:]
+    obj = zlib.decompressobj()
+    try:
+        out = obj.decompress(body, max_output_size)
+    except zlib.error as e:
+        raise Error(str(e)) from None
+    if obj.unconsumed_tail:
+        raise Error(f"output exceeds cap {max_output_size}")
+    if not obj.eof:
+        raise Error("truncated stream")
+    if declared >= 0 and len(out) != declared:
+        raise Error("declared size mismatch")
+    return out
+
+
+class StreamCompressor:
+    """compressobj() twin: .compress(bytes) / .flush(), magic-prefixed."""
+
+    def __init__(self, level: int = 3):
+        self._obj = zlib.compressobj(level)
+        self._first = True
+
+    def _prefix(self, out: bytes) -> bytes:
+        if self._first:
+            self._first = False
+            return _STREAM_MAGIC + out
+        return out
+
+    def compress(self, data: bytes) -> bytes:
+        return self._prefix(self._obj.compress(data))
+
+    def flush(self) -> bytes:
+        return self._prefix(self._obj.flush())
+
+
+class StreamDecompressor:
+    """decompressobj() twin for decompress_iter."""
+
+    def __init__(self):
+        self._obj = zlib.decompressobj()
+        self._head = b""
+        self._started = False
+
+    def decompress(self, chunk: bytes) -> bytes:
+        if not self._started:
+            self._head += chunk
+            if len(self._head) < 4:
+                return b""
+            if self._head[:4] != _STREAM_MAGIC:
+                raise Error("not a streaming frame")
+            chunk, self._head = self._head[4:], b""
+            self._started = True
+        try:
+            return self._obj.decompress(chunk)
+        except zlib.error as e:
+            raise Error(str(e)) from None

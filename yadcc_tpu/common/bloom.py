@@ -14,14 +14,23 @@ replica in yadcc/daemon/local/distributed_cache_reader.h:32-56.
 
 from __future__ import annotations
 
-from typing import Iterable, Tuple
+import sys
+from typing import Iterable, List, Tuple
 
 import numpy as np
 import xxhash
 
+from . import xxh64_np
+
 # Same constants as the reference's generator.
 DEFAULT_NUM_BITS = 27_584_639
 DEFAULT_NUM_HASHES = 10
+
+# Below this many keys the per-key C wheel call wins: the vectorized
+# path pays fixed bucketing + matrix-pack overhead (~50us) that a
+# handful of ~870ns digests never amortizes.  Measured crossover on the
+# 1-core harness is ~40-80 keys depending on key length; 64 splits it.
+VECTORIZE_MIN_KEYS = 64
 
 
 def key_fingerprint(key: str, salt: int) -> Tuple[int, int]:
@@ -33,25 +42,75 @@ def key_fingerprint(key: str, salt: int) -> Tuple[int, int]:
     return h1, h2
 
 
-def key_fingerprints(keys: Iterable[str], salt: int) -> np.ndarray:
-    """[N, 2] uint32 fingerprint array for batched (device) probing.
-
-    Hot path of the million-key Bloom batches (BASELINE configs[3]):
-    digests stream straight into a preallocated uint64 vector and the
-    (h1, h2) split is vectorized — 5x faster end-to-end than building
-    a Python list of tuples (round-2 bloom_bench: fingerprinting at
-    0.87s/1M keys dwarfed the 0.08s probe it fed)."""
-    if not isinstance(keys, (list, tuple)):
-        keys = list(keys)
-    seed = salt & 0xFFFFFFFFFFFFFFFF
-    dig = np.fromiter(
-        (xxhash.xxh64_intdigest(k.encode(), seed=seed) for k in keys),
+def _digests_loop(keys: List[bytes], seed: int) -> np.ndarray:
+    """Per-key C-extension digest loop: the tiny-batch path, and the
+    baseline bloom_bench measures the vectorized path against."""
+    return np.fromiter(
+        (xxhash.xxh64_intdigest(k, seed=seed) for k in keys),
         np.uint64, count=len(keys))
-    out = np.empty((len(keys), 2), np.uint32)
+
+
+def _split_digests(dig: np.ndarray) -> np.ndarray:
+    """uint64[N] digests -> [N, 2] uint32 (h1, h2), h2 forced odd —
+    the ONE host-side statement of the fingerprint split (the device
+    twin lives in ops/bloom_pipeline.py)."""
+    if sys.byteorder == "little":
+        # A little-endian u64 is already its (lo, hi) u32 pair in
+        # memory: one reinterpreting copy + one in-place OR, instead
+        # of two mask/shift/narrow passes over the whole batch.
+        out = dig.view(np.uint32).reshape(len(dig), 2).copy()
+        out[:, 1] |= 1
+        return out
+    out = np.empty((len(dig), 2), np.uint32)
     out[:, 0] = (dig & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     out[:, 1] = (((dig >> np.uint64(32)) | np.uint64(1))
                  & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     return out
+
+
+def key_fingerprints_loop(keys: Iterable[str], salt: int) -> np.ndarray:
+    """Per-key-loop twin of key_fingerprints; kept callable so the
+    benchmark can measure the crossover the batched path is gated on."""
+    if not isinstance(keys, (list, tuple)):
+        keys = list(keys)
+    seed = salt & 0xFFFFFFFFFFFFFFFF
+    return _split_digests(_digests_loop([k.encode() for k in keys], seed))
+
+
+def key_fingerprints(keys: Iterable[str], salt: int) -> np.ndarray:
+    """[N, 2] uint32 fingerprint array for batched (device) probing.
+
+    Hot path of the million-key Bloom batches (BASELINE configs[3]):
+    keys are bucketed by byte length, each bucket packed into a [N, L]
+    uint8 matrix and digested lane-parallel by the vectorized XXH64
+    (common/xxh64_np.py) — ~30 u64 vector ops per 32-byte stripe
+    amortized over the whole batch, vs ~870ns of per-key C-extension
+    call overhead (round-2 bloom_bench: fingerprinting at 0.87s/1M
+    keys dwarfed the 0.08s probe it fed).  Batches under
+    VECTORIZE_MIN_KEYS take the per-key loop, which wins below the
+    bucketing overhead's crossover."""
+    if not isinstance(keys, (list, tuple)):
+        keys = list(keys)
+    seed = salt & 0xFFFFFFFFFFFFFFFF
+    if len(keys) < VECTORIZE_MIN_KEYS:
+        dig = _digests_loop([k.encode() for k in keys], seed)
+    else:
+        # str keys go straight to the packer — the per-key .encode()
+        # list would cost a quarter of the whole vectorized budget.
+        dig = xxh64_np.xxh64_keys(keys, seed)
+    return _split_digests(dig)
+
+
+def probe_indices_batch(fps: np.ndarray, num_hashes: int,
+                        num_bits: int) -> np.ndarray:
+    """[N, K] int64 probe indices for an [N, 2] fingerprint batch —
+    the vectorized restatement of probe_indices (same uint32
+    wrap-around then mod num_bits; keep all three in sync:
+    probe_indices, this, and ops/bloom_probe.py:probe_body)."""
+    i = np.arange(num_hashes, dtype=np.uint32)[None, :]
+    h1 = fps[:, 0][:, None]
+    h2 = fps[:, 1][:, None]
+    return ((h1 + i * h2) % np.uint32(num_bits)).astype(np.int64)
 
 
 def probe_indices(h1: int, h2: int, num_hashes: int, num_bits: int) -> np.ndarray:
@@ -104,8 +163,18 @@ class SaltedBloomFilter:
         )
 
     def add_many(self, keys: Iterable[str]) -> None:
-        for k in keys:
-            self.add(k)
+        """Batched insert: one vectorized fingerprint pass, one [N, K]
+        index derivation, one scatter-OR — the filter-rebuild hot path
+        (a 1M-key rebuild was 1M per-key digest calls before)."""
+        if not isinstance(keys, (list, tuple)):
+            keys = list(keys)
+        if not keys:
+            return
+        fps = key_fingerprints(keys, self.salt)
+        idx = probe_indices_batch(fps, self.num_hashes, self.num_bits)
+        np.bitwise_or.at(
+            self._words, idx >> 5,
+            (np.uint32(1) << (idx & 31).astype(np.uint32)))
 
     # -- queries ----------------------------------------------------------
 
@@ -114,6 +183,19 @@ class SaltedBloomFilter:
         idx = probe_indices(h1, h2, self.num_hashes, self.num_bits)
         bits = (self._words[idx >> 5] >> (idx & 31).astype(np.uint32)) & 1
         return bool(bits.all())
+
+    def may_contain_batch(self, keys: Iterable[str]) -> np.ndarray:
+        """bool[N] membership, fully vectorized on the host: batched
+        fingerprints feed one [N, K] gather.  Bit-identical to
+        may_contain per key (asserted by tests/test_bloom_fast.py)."""
+        if not isinstance(keys, (list, tuple)):
+            keys = list(keys)
+        if not keys:
+            return np.zeros(0, bool)
+        fps = key_fingerprints(keys, self.salt)
+        idx = probe_indices_batch(fps, self.num_hashes, self.num_bits)
+        bits = (self._words[idx >> 5] >> (idx & 31).astype(np.uint32)) & 1
+        return bits.all(axis=1)
 
     def fill_ratio(self) -> float:
         ones = int(np.unpackbits(self._words.view(np.uint8)).sum())

@@ -1,16 +1,33 @@
 """zstd helpers (one-shot + streaming), parity with the reference's
 flare compression and the client's zstd output stream
-(yadcc/client/common/compress.{h,cc}, output_stream.{h,cc})."""
+(yadcc/client/common/compress.{h,cc}, output_stream.{h,cc}).
+
+When the `zstandard` wheel is absent (minimal containers), a stdlib
+zlib stand-in keeps the same API: framed one-shot payloads carry a
+declared-size header so the pre-allocation cap check still works, and
+streaming frames decompress under the same output cap.  The two
+formats do not interoperate — every component in a zstd-less process
+speaks the fallback, which is the only deployment such a process can
+be part of anyway (the wire peer would need the same build)."""
 
 from __future__ import annotations
 
 from typing import Iterable, Optional
 
-import zstandard
+try:
+    import zstandard
+except ImportError:  # gated: minimal containers ship no zstd wheel
+    zstandard = None
+    from . import _zlib_frames as _fallback
 
 # Reference tunes for throughput, not ratio: zstd eats ~15% of client CPU
 # at the default level (yadcc/doc/rationale.md:94).
 _LEVEL = 3
+
+# The error type callers may catch regardless of which backend is
+# compiled in (zstandard.ZstdError when the wheel is present).
+CompressionError = (zstandard.ZstdError if zstandard is not None
+                    else _fallback.Error)
 
 # zstandard (de)compressor objects are not safe for concurrent use from
 # multiple threads, and the daemons serve RPCs on thread pools — keep one
@@ -32,6 +49,8 @@ def _ctx() -> tuple:
 
 
 def compress(data: bytes) -> bytes:
+    if zstandard is None:
+        return _fallback.compress(data, _LEVEL)
     return _ctx()[0].compress(data)
 
 
@@ -49,6 +68,8 @@ def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:
     # the full allocation (fuzz-found, tests/test_fuzz_parsers.py).
     # Check the declared size ourselves before touching the allocator
     # (-1 = streaming/unknown; raises on malformed headers).
+    if zstandard is None:
+        return _fallback.decompress(data, max_output_size)
     declared = zstandard.frame_content_size(data)
     if declared > max_output_size:
         raise zstandard.ZstdError(
@@ -59,7 +80,7 @@ def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:
 def try_decompress(data: bytes) -> Optional[bytes]:
     try:
         return decompress(data)
-    except (zstandard.ZstdError, MemoryError, ValueError):
+    except (CompressionError, MemoryError, ValueError):
         # Corruption — including allocation-level failures — must read
         # as a miss, never take down the serving thread.
         return None
@@ -72,7 +93,10 @@ class CompressingWriter:
 
     def __init__(self, sink):
         self._sink = sink
-        self._obj = zstandard.ZstdCompressor(level=_LEVEL).compressobj()
+        self._obj = (_fallback.StreamCompressor(_LEVEL)
+                     if zstandard is None
+                     else zstandard.ZstdCompressor(level=_LEVEL)
+                     .compressobj())
         self._closed = False
 
     def write(self, data: bytes) -> int:
@@ -102,5 +126,6 @@ class TeeWriter:
 
 
 def decompress_iter(chunks: Iterable[bytes]) -> bytes:
-    obj = _ctx()[1].decompressobj()
+    obj = (_fallback.StreamDecompressor() if zstandard is None
+           else _ctx()[1].decompressobj())
     return b"".join(obj.decompress(c) for c in chunks)

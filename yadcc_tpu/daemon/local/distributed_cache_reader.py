@@ -87,7 +87,11 @@ class DistributedCacheReader:
 
     def batch_may_contain(self, keys: List[str]):
         """Device-side batch Bloom test; numpy bool array (all-True when
-        no filter is synced yet — absence of evidence isn't a miss)."""
+        no filter is synced yet — absence of evidence isn't a miss).
+
+        Rides the fused fingerprint→probe pipeline: the replica's raw
+        key bytes go up once and one bool[N] comes back — no host
+        hashing, no [N, 2] fingerprint upload (ops/bloom_pipeline.py)."""
         import numpy as np
 
         with self._lock:
@@ -96,12 +100,11 @@ class DistributedCacheReader:
             return np.ones(len(keys), bool)
         import jax.numpy as jnp
 
-        from ...ops.bloom_probe import bloom_may_contain
+        from ...ops.bloom_pipeline import bloom_membership_batch
 
-        fps = bloom.key_fingerprints(keys, self._salt)
-        return np.asarray(bloom_may_contain(
-            jnp.asarray(flt.words), jnp.asarray(fps),
-            num_bits=flt.num_bits, num_hashes=flt.num_hashes))
+        return bloom_membership_batch(
+            jnp.asarray(flt.words), keys, self._salt,
+            num_bits=flt.num_bits, num_hashes=flt.num_hashes)
 
     # -- sync ----------------------------------------------------------------
 
@@ -130,8 +133,10 @@ class DistributedCacheReader:
             self._last_fetch = now
             if resp.incremental:
                 if self._filter is not None:
-                    for key in resp.newly_populated_keys:
-                        self._filter.add(key)
+                    # Batched insert: one vectorized fingerprint pass
+                    # over the sync window, not a digest call per key.
+                    self._filter.add_many(
+                        list(resp.newly_populated_keys))
             else:
                 data = compress.try_decompress(att)
                 if data is not None and len(data) > 4:

@@ -1,0 +1,121 @@
+"""Fused fingerprint→probe Bloom pipeline: raw key bytes up, bool back.
+
+Round-2's bloom_bench exposed the anti-win in the device Bloom path:
+the probe kernel resolved 1M keys in 0.083s while the HOST spent
+0.87-1.01s fingerprinting them one xxhash call at a time, then shipped
+an [N, 2] fingerprint matrix up.  This module closes the loop: ONE
+jitted call takes the packed key-byte matrix, computes the XXH64
+digest on device (ops/xxh64_jax.py — pure elementwise u32-pair math,
+VPU-shaped), applies the same odd-forcing (h1, h2) split the host uses
+(common/bloom.py:_split_digests), and feeds the shared probe body
+(ops/bloom_probe.py:probe_body).  No fingerprint round-trip through
+the host; the only H2D traffic is the key bytes, the only D2H a
+bool[N].
+
+Variable-length batches ride the same length-bucketing the host
+vectorized path uses (one fused call per byte-length class; compile
+cache keyed on length, so steady-state key populations — fixed-width
+cache-entry digests — hit one compiled kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .bloom_probe import probe_body
+from .xxh64_jax import xxh64_device
+
+
+def seed_pair(salt: int) -> jnp.ndarray:
+    """uint32[2] (hi, lo) seed for the device digest from a filter salt
+    — same masking as the host path (common/bloom.py)."""
+    s = salt & 0xFFFFFFFFFFFFFFFF
+    return jnp.asarray([s >> 32, s & 0xFFFFFFFF], jnp.uint32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("length", "num_bits", "num_hashes"))
+def bloom_membership_from_keys(
+    words: jax.Array,        # uint32[W] filter bit-array
+    packed_keys: jax.Array,  # uint32[N, ceil(length/8)*2] (pack_keys)
+    length: int,             # key byte length (static: unrolls digest)
+    seed: jax.Array,         # uint32[2] (hi, lo), see seed_pair
+    *,
+    num_bits: int,
+    num_hashes: int,
+) -> jax.Array:
+    """bool[N] membership in ONE fused kernel: device XXH64 → odd-h2
+    split → shared probe body.  Bit-identical to the host chain
+    key_fingerprint → probe_indices → word test (asserted by
+    tests/test_bloom_fast.py)."""
+    hi, lo = xxh64_device(packed_keys, length, seed)
+    # The fingerprint split, device twin of common/bloom.py
+    # _split_digests: h1 = low word, h2 = high word forced odd.
+    fps = jnp.stack([lo, hi | jnp.uint32(1)], axis=1)
+    return probe_body(words, fps, num_bits, num_hashes)
+
+
+def pack_key_buckets(keys) -> list:
+    """[(length, row_indices, uint32 [M, ceil(length/8)*2] matrix)] per
+    byte-length class — the xxh64_device input layout, built with the
+    same C-level pack + vectorized grouping as the host digest
+    (common/xxh64_np.py): no per-key Python anywhere.  The pack is the
+    host's entire job on the fused path, so its cost IS the host-side
+    cost; a dict-of-lists bucketing here measured 5x the pack itself."""
+    from ..common.xxh64_np import pack_key_matrix
+
+    n = len(keys)
+    if n == 0:
+        return []
+    try:
+        mat, lengths = pack_key_matrix(keys)
+    except UnicodeEncodeError:  # non-ASCII str keys: utf-8, re-pack
+        mat, lengths = pack_key_matrix(
+            [k.encode() if isinstance(k, str) else k for k in keys])
+    lo = int(lengths.min())
+    if lo == int(lengths.max()):
+        # Single length class (fixed-width production keys): the pack
+        # IS the bucket — no sort, no gather.
+        return [(lo, slice(None), mat.view("<u4"))]
+    order = np.argsort(lengths, kind="stable")
+    sl = lengths[order]
+    group_starts = np.flatnonzero(np.diff(sl, prepend=-1))
+    buckets = []
+    for gi, gs in enumerate(group_starts):
+        ge = group_starts[gi + 1] if gi + 1 < len(group_starts) else n
+        length = int(sl[gs])
+        idxs = order[gs:ge]
+        aligned = length + (-length) % 8
+        sub = (mat if len(idxs) == n and mat.shape[1] == aligned
+               else np.ascontiguousarray(mat[idxs, :aligned]))
+        buckets.append((length, idxs, sub.view("<u4")))
+    return buckets
+
+
+def bloom_membership_batch(
+    words_dev: jax.Array,
+    keys,
+    salt: int,
+    *,
+    num_bits: int,
+    num_hashes: int,
+) -> np.ndarray:
+    """Variable-length front door over the fused kernel: bucket keys by
+    byte length, pack each bucket (host's only job), run one fused call
+    per length class, scatter the bools back in input order.
+
+    `words_dev` is the filter's uint32 word array, already resident on
+    the device (upload once per filter sync, not per batch)."""
+    out = np.empty(len(keys), bool)
+    seed = seed_pair(salt)
+    for length, idxs, packed in pack_key_buckets(keys):
+        got = bloom_membership_from_keys(
+            words_dev, jnp.asarray(packed), length, seed,
+            num_bits=num_bits, num_hashes=num_hashes)
+        out[idxs] = np.asarray(got)
+    return out

@@ -16,13 +16,27 @@ the 8-virtual-device CPU mesh used in tests, and on real TPU slices.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax <= 0.4.x keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # promoted to the top level in newer jax
+    from jax import shard_map as _shard_map
+
+# The replication-check kwarg was renamed check_rep -> check_vma across
+# the promotion; accept the new spelling and translate for old jax.
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
 
 from ..models.cost import DEFAULT_COST_MODEL, DispatchCostModel
 from ..ops.assignment import NO_PICK, PoolArrays, TaskBatch, _scores
@@ -380,6 +394,78 @@ def sharded_bloom_probe_fn(mesh: Mesh, *, num_bits: int, num_hashes: int):
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS, None)),
         out_specs=P(WORKER_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def bloom_words_padded(words: np.ndarray, mesh: Mesh,
+                       num_bits: int) -> np.ndarray:
+    """Filter word array zero-padded to the mesh's shard grid: the
+    partitioned_shard_bounds layout splits ceil(W / n_dev) words per
+    device, so the array must be an exact multiple for shard_map.  Zero
+    pad is semantically inert — padded words hold no set bits and no
+    probe index reaches them (idx < num_bits <= W*32)."""
+    from ..ops.bloom_probe import partitioned_shard_bounds
+
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    bounds = partitioned_shard_bounds(num_bits, n_dev)
+    per = bounds[1] - bounds[0]
+    return np.pad(words, (0, n_dev * per - words.shape[0]))
+
+
+def sharded_bloom_membership_fn(mesh: Mesh, *, length: int, num_bits: int,
+                                num_hashes: int):
+    """FILTER-sharded fused fingerprint→probe pipeline: each device
+    holds one partitioned_shard_bounds slice of the filter words
+    (HBM scaling: a filter bigger than one chip's memory still probes
+    in one launch), the packed key matrix is replicated, and each
+    device resolves the probes landing in its own word range — indices
+    outside it contribute True.  One pmin per mesh axis ANDs the
+    partial verdicts; works identically on the 1-level and 2-level
+    meshes.
+
+    The digest is recomputed per device (replicated compute): XXH64 is
+    ~30 fused vector passes over [N] lanes, far cheaper than gathering
+    words across shards would be.
+
+    Returns a jitted (words_padded, packed_keys, seed) -> bool[N];
+    words_padded from bloom_words_padded, packed_keys from
+    ops/xxh64_jax.pack_keys, seed from ops/bloom_pipeline.seed_pair.
+    """
+    from ..ops.bloom_probe import partitioned_shard_bounds
+    from ..ops.xxh64_jax import xxh64_device
+
+    axes = tuple(mesh.axis_names)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    bounds = partitioned_shard_bounds(num_bits, n_dev)
+    per = bounds[1] - bounds[0]          # words per device slice
+
+    def body(words_local, packed, seed):
+        hi, lo = xxh64_device(packed, length, seed)
+        # Same derivation as ops/bloom_probe.py:probe_body (keep in
+        # lockstep), restated over a word SLICE: out-of-slice probes
+        # pass vacuously and the cross-device AND finishes the test.
+        h1 = lo[:, None]
+        h2 = (hi | jnp.uint32(1))[:, None]
+        i = jnp.arange(num_hashes, dtype=jnp.uint32)[None, :]
+        idx = (h1 + i * h2) % jnp.uint32(num_bits)          # [N, K]
+        widx = (idx >> 5).astype(jnp.int32)
+        local = widx - device_linear_index(mesh, axes) * per
+        mine = (local >= 0) & (local < per)
+        word = words_local[jnp.clip(local, 0, per - 1)]
+        bit = (word >> (idx & 31)) & jnp.uint32(1)
+        ok = jnp.all((bit == 1) | ~mine, axis=1)
+        verdict = ok.astype(jnp.int32)
+        for name in reversed(axes):      # logical AND == pmin on 0/1
+            verdict = jax.lax.pmin(verdict, name)
+        return verdict > 0
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes), P(), P()),
+        out_specs=P(),
         check_vma=False,
     )
     return jax.jit(fn)

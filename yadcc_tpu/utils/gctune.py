@@ -50,10 +50,18 @@ class LatencyGcGuard:
         self._last_full = 0.0
         self._young_passes = 0
         self._full_passes = 0
+        self._was_enabled = True
+        self._prior_frozen = 0
         exposed_vars.expose("yadcc/gc_guard", self.inspect)
 
     def start(self) -> None:
         """Call once, after startup/warmup built the long-lived heap."""
+        # Snapshot the collector state we are about to override, so
+        # stop() restores what the process actually had — a host that
+        # deliberately runs with GC off (or with its own frozen set)
+        # must not find it force-enabled (or force-unfrozen) after us.
+        self._was_enabled = gc.isenabled()
+        self._prior_frozen = gc.get_freeze_count()
         gc.collect()          # drain pre-existing garbage first
         gc.freeze()           # startup heap: immortal, stop scanning it
         gc.disable()          # no threshold-triggered pauses hereafter
@@ -78,8 +86,13 @@ class LatencyGcGuard:
     def stop(self) -> None:
         if self._active:
             self._active = False
-            gc.enable()
-            gc.unfreeze()
+            if self._was_enabled:
+                gc.enable()
+            # gc.unfreeze() is all-or-nothing: only safe to undo our
+            # freeze when nothing was frozen before start() — otherwise
+            # we would thaw objects some other owner pinned on purpose.
+            if self._prior_frozen == 0:
+                gc.unfreeze()
 
     def inspect(self) -> dict:
         return {
