@@ -1,0 +1,14 @@
+# Top-level convenience targets.  `make check` is the cold-clone gate
+# (native build + tier-1 pytest) that mirrors the reference's per-push
+# CI (yadcc .github/workflows/build-and-test.yml) — see tools/ci.sh.
+
+.PHONY: check native clean
+
+check:
+	bash tools/ci.sh
+
+native:
+	$(MAKE) -C native
+
+clean:
+	$(MAKE) -C native clean

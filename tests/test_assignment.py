@@ -52,6 +52,37 @@ def random_tasks(rng, t, s, n_envs):
     ]
 
 
+class TestFastGreedyVsReference:
+    """The production host path (bounded-heap greedy_assign) must be
+    outcome-identical to the O(T*S) reference loop it replaced — picks
+    AND final running, over pools with every gate exercised."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+    def test_matches_reference_loop(self, seed):
+        from dataclasses import replace
+
+        rng = np.random.default_rng(100 + seed)
+        s = int(rng.integers(2, 200))
+        pool_np = random_pool_np(rng, s)
+        # Mix long runs (one build flooding one env — the descriptor
+        # shape that takes the heap path) with singleton requests.
+        tasks = []
+        while len(tasks) < 150:
+            d = (int(rng.integers(0, 256)), int(rng.integers(1, 4)),
+                 int(rng.integers(-1, s)))
+            tasks.extend([d] * int(rng.integers(1, 60)))
+        tasks = tasks[:150]
+        cm = replace(DEFAULT_COST_MODEL,
+                     avoid_self=bool(rng.random() < 0.5))
+
+        ref_pool = {k: v.copy() for k, v in pool_np.items()}
+        fast_pool = {k: v.copy() for k, v in pool_np.items()}
+        expect = asn.greedy_assign_reference(ref_pool, tasks, cm)
+        got = asn.greedy_assign(fast_pool, tasks, cm)
+        assert got == expect
+        assert np.array_equal(fast_pool["running"], ref_pool["running"])
+
+
 class TestKernelVsOracle:
     @pytest.mark.parametrize("seed", [0, 1, 2, 3])
     def test_matches_oracle(self, seed):

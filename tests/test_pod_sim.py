@@ -80,7 +80,8 @@ class TestMechanics:
 
 def test_small_end_to_end_run():
     sim = PodSim(servants=32, capacity=4, policy="greedy_cpu",
-                 exec_ms=4.0, churn_per_s=1)
+                 exec_ms=4.0, churn_per_s=1,
+                 capacity_dist="uniform:2:8")
     out = sim.run(4000, dup_rate=0.4, submitters=4)
     b = out["breakdown"]
     assert out["tasks"] == 4000
@@ -89,3 +90,35 @@ def test_small_end_to_end_run():
     assert out["tasks_per_sec"] > 100
     assert out["grants_granted"] == out["scheduler_stats"]["granted"]
     assert out["cache"]["fills"] == b["actually_run"] + b["retries"]
+    # Heterogeneous capacities really flowed into the fleet.
+    assert out["capacity_dist"] == "uniform:2:8"
+    lo, hi = out["capacity_min_max"]
+    assert 2 <= lo <= hi <= 8
+    # The grant path ran through the RPC service and every stage of
+    # the decomposition recorded.
+    lb = out["latency_breakdown"]
+    for stage in ("queue_wait_ms", "snapshot_ms", "policy_ms",
+                  "apply_ms", "dispatch_cycle_ms", "rpc_handler_ms",
+                  "rpc_serialize_ms", "transport_ms", "grant_call_ms"):
+        assert lb[stage] is not None and lb[stage]["count"] > 0, stage
+        assert lb[stage]["p99_ms"] >= lb[stage]["p50_ms"] >= 0.0
+    assert out["dispatch_only_p99_ms"] == lb["dispatch_cycle_ms"]["p99_ms"]
+
+
+def test_capacity_dist_parsing():
+    import numpy as np
+    import pytest as _pytest
+
+    from yadcc_tpu.tools.pod_sim import parse_capacity_dist
+
+    rng = np.random.default_rng(3)
+    assert parse_capacity_dist("fixed", 7)(rng) == 7
+    u = parse_capacity_dist("uniform:4:16", 8)
+    vals = {u(rng) for _ in range(200)}
+    assert min(vals) >= 4 and max(vals) <= 16 and len(vals) > 5
+    b = parse_capacity_dist("bimodal:2:32:0.25", 8)
+    vals = [b(rng) for _ in range(300)]
+    assert set(vals) == {2, 32}
+    for bad in ("uniform:9:4", "bimodal:1:2", "nope", "uniform:0:4"):
+        with _pytest.raises(ValueError):
+            parse_capacity_dist(bad, 8)

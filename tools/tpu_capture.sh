@@ -38,9 +38,12 @@ recover() {
   # probes, leftover bench children.  Probe timeouts orphan PJRT
   # clients; the pool only re-grants once the holder is gone.
   # Scoped two ways (advisor r4): skip our own process group, and
-  # only touch processes running from this checkout — a cluster or
-  # daemon legitimately started elsewhere is not ours to kill.
-  local pids pid mypg pg cwd
+  # only touch processes of this checkout — mirroring teardown.sh's
+  # is_ours, a process is ours when its cwd resolves under the
+  # checkout OR its cmdline references the checkout path (a stale
+  # PJRT holder that chdir'd away or daemonized to / was previously
+  # skipped silently and the tunnel never reclaimed; ADVICE r5 low#2).
+  local pids pid mypg pg cwd ours
   mypg=$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')
   pids=$(pgrep -f 'yadcc_tpu\.(scheduler|cache|daemon)\.entry' \
          ; pgrep -f 'ytpu_probe_marker' \
@@ -49,8 +52,20 @@ recover() {
     [ "$pid" = "$$" ] && continue
     pg=$(ps -o pgid= -p "$pid" 2>/dev/null | tr -d ' ')
     [ -n "$mypg" ] && [ "$pg" = "$mypg" ] && continue
+    ours=no
     cwd=$(readlink "/proc/$pid/cwd" 2>/dev/null) || cwd=
-    case "$cwd" in "$PWD"|"$PWD"/*) ;; *) continue ;; esac
+    case "$cwd" in "$PWD"|"$PWD"/*) ours=yes ;; esac
+    if [ "$ours" = no ] && tr '\0' ' ' < "/proc/$pid/cmdline" \
+        2>/dev/null | grep -qF "$PWD"; then
+      ours=yes
+    fi
+    if [ "$ours" = no ]; then
+      # Pattern-matching but not attributable to this checkout: leave
+      # it, and leave a trace for diagnosis instead of silence.
+      echo "$(date -Is) recover: skipping pid $pid (cwd=${cwd:-?};" \
+           "no checkout reference)" >> "$LOG"
+      continue
+    fi
     kill -9 "$pid" 2>/dev/null \
       && echo "$(date -Is) recover: killed holder pid $pid" >> "$LOG"
   done

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import struct
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Tuple
 
@@ -61,10 +62,16 @@ class MethodSpec:
 
 @dataclass
 class ServiceSpec:
-    """A mountable service: name plus method table."""
+    """A mountable service: name plus method table.
+
+    `stage_timer` (optional, a utils.stagetimer.StageTimer) makes
+    dispatch_frame record per-method `<Method>:handler` and
+    `<Method>:serialize` stages — the server-side half of the grant
+    path's latency decomposition (doc/scheduler.md)."""
 
     service_name: str
     methods: Dict[str, MethodSpec] = field(default_factory=dict)
+    stage_timer: Optional[object] = None
 
     def add(self, name: str, request_cls: type, handler: Handler) -> None:
         self.methods[name] = MethodSpec(name, request_cls, handler)
@@ -81,13 +88,29 @@ def method(spec: ServiceSpec, request_cls: type):
 
 
 def encode_frame(status: int, meta: bytes, attachment: bytes = b"") -> bytes:
-    return _HEADER.pack(status, len(meta)) + meta + attachment
+    # join over `+`: one allocation for the reply instead of two
+    # intermediate concatenation copies on the grant-reply hot path.
+    if not attachment:
+        return _HEADER.pack(status, len(meta)) + meta
+    return b"".join((_HEADER.pack(status, len(meta)), meta, attachment))
 
 
 def decode_frame(data: bytes) -> Tuple[int, bytes, bytes]:
     status, meta_len = _HEADER.unpack_from(data)
     off = _HEADER.size
     return status, data[off : off + meta_len], data[off + meta_len :]
+
+
+# Per-thread duration of the last dispatch_frame call (decode + handler
+# + serialize), in seconds.  An in-process transport (mock://) runs the
+# server on the caller's thread, so the client can subtract this from
+# its wall time to get the pure transport/framing stage — how pod_sim
+# decomposes grant_call latency.
+_tls = threading.local()
+
+
+def last_server_inner_s() -> Optional[float]:
+    return getattr(_tls, "server_inner_s", None)
 
 
 def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:
@@ -97,6 +120,8 @@ def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> byte
     crashes all turn into status frames, so mock:// and grpc:// expose
     identical failure semantics to callers.
     """
+    timer = spec.stage_timer
+    t0 = _time.perf_counter()
     ms = spec.methods.get(name)
     if ms is None:
         return encode_frame(STATUS_METHOD_NOT_FOUND, b"")
@@ -110,11 +135,24 @@ def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> byte
     try:
         resp = ms.handler(req, attachment, ctx)
     except RpcError as e:
-        return encode_frame(e.status, e.message.encode())
+        out = encode_frame(e.status, e.message.encode())
+        _tls.server_inner_s = _time.perf_counter() - t0
+        return out
     except Exception as e:
-        return encode_frame(STATUS_TRANSPORT_FAILURE,
-                            f"handler error: {e!r}".encode())
-    return encode_frame(0, resp.SerializeToString(), ctx.response_attachment)
+        out = encode_frame(STATUS_TRANSPORT_FAILURE,
+                           f"handler error: {e!r}".encode())
+        _tls.server_inner_s = _time.perf_counter() - t0
+        return out
+    t1 = _time.perf_counter()
+    out = encode_frame(0, resp.SerializeToString(), ctx.response_attachment)
+    t2 = _time.perf_counter()
+    if timer is not None:
+        # handler covers request decode too (both are message-codec
+        # work on the request side; the response side is `serialize`).
+        timer.record(f"{name}:handler", t1 - t0)
+        timer.record(f"{name}:serialize", t2 - t1)
+    _tls.server_inner_s = t2 - t0
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -131,6 +131,11 @@ def scheduler_start(args) -> None:
         token_rotation_s=args.token_rollout_interval,
     )
     exposed_vars.expose("yadcc/task_dispatcher", dispatcher.inspect)
+    # RPC-side grant-path stages (<Method>:handler / <Method>:serialize);
+    # the dispatcher's queue-wait -> apply stages ride its inspect()
+    # above as `latency_breakdown` (doc/scheduler.md, stage budget).
+    exposed_vars.expose("yadcc/scheduler_rpc",
+                        service.stage_timer.percentiles)
 
     # Heap is fully built (policy warmed, dispatcher constructed):
     # freeze it and take the automatic cyclic collector off the grant

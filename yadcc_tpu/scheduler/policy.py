@@ -811,7 +811,15 @@ class AutoPolicy(DispatchPolicy):
         timed end-to-end (upload + kernel + download), so a remote-
         attached accelerator's transport RTT lands in the threshold —
         the whole point: the analytic model knows S, only a measurement
-        knows the deployment."""
+        knows the deployment.
+
+        Both routes are measured at TWO batch sizes and modeled affine
+        (cost = a + b*n): the greedy host path is flat O(S) mask work
+        plus a tiny per-request heap term (runs of identical
+        descriptors — the production shape, one build floods one env),
+        NOT linear per request, so the old cost/len(reqs) slope put the
+        crossover ~30x too low and sent mid-size backlogs to a device
+        call several times slower."""
         import time as _time
 
         import numpy as _np
@@ -828,22 +836,34 @@ class AutoPolicy(DispatchPolicy):
                                     _np.uint32),
             )
 
-        reqs = [AssignRequest(1, 1, -1)] * 8
+        n_lo, n_hi = 8, 128
+
+        def timed(policy, n):
+            reqs = [AssignRequest(1, 1, -1)] * n
+            policy.assign(mksnap(), reqs)   # compile/warm this shape
+            t0 = _time.perf_counter()
+            policy.assign(mksnap(), reqs)
+            return _time.perf_counter() - t0
+
         try:
-            self._grouped.assign(mksnap(), reqs)   # compile/warm path
-            t0 = _time.perf_counter()
-            self._grouped.assign(mksnap(), reqs)
-            device_call_s = _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            self._greedy.assign(mksnap(), reqs)
-            greedy_per_req_s = (_time.perf_counter() - t0) / len(reqs)
-            self._measured_threshold = max(
-                1.0, device_call_s / max(greedy_per_req_s, 1e-9))
+            g_lo, g_hi = timed(self._greedy, n_lo), timed(self._greedy, n_hi)
+            d_lo, d_hi = timed(self._grouped, n_lo), timed(self._grouped, n_hi)
+            b_g = (g_hi - g_lo) / (n_hi - n_lo)
+            b_d = (d_hi - d_lo) / (n_hi - n_lo)
+            if b_g <= b_d:
+                # Greedy's slope is no worse than the device's: whoever
+                # is cheaper at the large probe stays cheaper forever.
+                threshold = float("inf") if g_hi <= d_hi else 1.0
+            else:
+                # a_g + b_g*n = a_d + b_d*n at the crossover.
+                a_g, a_d = g_lo - b_g * n_lo, d_lo - b_d * n_lo
+                threshold = max(1.0, (a_d - a_g) / (b_g - b_d))
+            self._measured_threshold = threshold
             logger.info(
-                "auto crossover calibrated: device call %.3fms, greedy "
-                "%.3fms/req, threshold n*=%.1f (pool %d)",
-                device_call_s * 1e3, greedy_per_req_s * 1e3,
-                self._measured_threshold, pool_size)
+                "auto crossover calibrated: greedy %.3f/%.3fms, device "
+                "%.3f/%.3fms at n=%d/%d, threshold n*=%.1f (pool %d)",
+                g_lo * 1e3, g_hi * 1e3, d_lo * 1e3, d_hi * 1e3,
+                n_lo, n_hi, self._measured_threshold, pool_size)
         except Exception:
             logger.exception("auto calibration failed; keeping the "
                              "analytic crossover")

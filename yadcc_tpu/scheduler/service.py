@@ -17,6 +17,7 @@ from ..common.token_verifier import TokenVerifier, generate_token
 from ..rpc import RpcContext, RpcError, ServiceSpec
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
+from ..utils.stagetimer import StageTimer
 from .running_task_bookkeeper import RunningTaskBookkeeper, RunningTaskRecord
 from .task_dispatcher import ServantInfo, TaskDispatcher
 
@@ -80,11 +81,15 @@ class SchedulerService:
         self._user_tokens = user_tokens
         self._servant_tokens = servant_tokens
         self._min_version = min_daemon_version
+        # RPC-side stages of the grant path (<Method>:handler /
+        # <Method>:serialize, recorded by rpc.transport.dispatch_frame);
+        # the dispatcher's own stage_timer covers queue-wait -> apply.
+        self.stage_timer = StageTimer(maxlen=16384)
 
     # -- wiring ------------------------------------------------------------
 
     def spec(self) -> ServiceSpec:
-        s = ServiceSpec(SERVICE_NAME)
+        s = ServiceSpec(SERVICE_NAME, stage_timer=self.stage_timer)
         s.add("Heartbeat", api.scheduler.HeartbeatRequest, self.Heartbeat)
         s.add("GetConfig", api.scheduler.GetConfigRequest, self.GetConfig)
         s.add("WaitForStartingTask", api.scheduler.WaitForStartingTaskRequest,

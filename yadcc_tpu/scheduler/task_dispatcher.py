@@ -34,6 +34,7 @@ import numpy as np
 
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
+from ..utils.stagetimer import StageTimer
 from ..ops.assignment import NO_PICK
 from .policy import AssignRequest, DispatchPolicy, EnvRegistry, PoolSnapshot
 
@@ -43,6 +44,15 @@ logger = get_logger("scheduler.dispatcher")
 # without servant confirmation (e.g. the servant died as well and its
 # registry entry vanished before reporting).
 _ZOMBIE_TIMEOUT_S = 60.0
+
+# Staged heartbeats are force-applied once this many accumulate, so a
+# beat is never more than ~threshold/beat-rate stale even if no grant
+# cycle runs (a 5k/s fleet flushes every ~13ms).
+_HB_FLUSH_THRESHOLD = 64
+
+# A snapshot buffer whose dirty set covers more than this fraction of
+# the pool rebuilds vectorized instead of via fancy-index updates.
+_SNAP_FULL_REBUILD_FRAC = 8  # 1/8 of slots
 
 
 @dataclass
@@ -80,6 +90,29 @@ class _Grant:
     requestor: str = ""
 
 
+class _SnapBuffer:
+    """One prepared PoolSnapshot backing store, maintained incrementally.
+
+    The arrays are only written during publication (under the dispatcher
+    lock, while not leased); a leased buffer is read-only until released,
+    so the policy can consume it outside the lock while heartbeats keep
+    mutating the live pool arrays."""
+
+    __slots__ = ("alive", "capacity", "running", "dedicated", "version",
+                 "env", "dirty", "leased", "full_rebuild")
+
+    def __init__(self, max_servants: int, env_words: int):
+        self.alive = np.zeros(max_servants, bool)
+        self.capacity = np.zeros(max_servants, np.int32)
+        self.running = np.zeros(max_servants, np.int32)
+        self.dedicated = np.zeros(max_servants, bool)
+        self.version = np.zeros(max_servants, np.int32)
+        self.env = np.zeros((max_servants, env_words), np.uint32)
+        self.dirty: Set[int] = set()
+        self.leased = False
+        self.full_rebuild = True
+
+
 @dataclass
 class _Pending:
     env_id: int
@@ -91,6 +124,8 @@ class _Pending:
     immediate_left: int
     prefetch_left: int
     deadline: float
+    enqueued_at: float = 0.0
+    queue_wait_recorded: bool = False
     first_cycle_done: bool = False
     abandoned: bool = False  # caller gave up; grants must not be issued
     # Pipelined mode: entries launched but not yet drained.  Selection
@@ -172,6 +207,32 @@ class TaskDispatcher:
         self._stopping = False
         self._stats = {"granted": 0, "expired_grants": 0, "zombies_killed": 0}
 
+        # Per-stage grant-path latency (queue-wait -> snapshot -> policy
+        # -> apply), timed with the injectable clock; surfaces in
+        # inspect() / pod_sim latency_breakdown.
+        self.stage_timer = StageTimer(
+            ("queue_wait", "snapshot", "policy", "apply",
+             "dispatch_cycle"), maxlen=16384)
+
+        # Heartbeat staging: steady-state beats of ALREADY-REGISTERED
+        # servants are recorded under a cheap leaf lock and applied in
+        # batches (cycle start / expiration sweep / threshold), so a 5k
+        # beats/s fleet doesn't contend slot-by-slot with dispatch on
+        # the main lock.  Joins, leaves, and registry-full detection
+        # stay synchronous on the main lock.
+        self._hb_lock = threading.Lock()
+        self._hb_staged: Dict[str, Tuple[ServantInfo, float]] = {}
+
+        # Prepared-snapshot buffers (see _snapshot_locked): dispatch
+        # cycles read an incrementally-maintained snapshot instead of
+        # copying six pool arrays under the lock every cycle.
+        self._snap_buffers: List[_SnapBuffer] = []
+        # Sync mode releases each lease when the policy returns, so two
+        # buffers suffice (one leased, one publishing); pipelined mode
+        # holds a lease per in-flight launch until its drain.
+        self._max_snap_buffers = (
+            pipeline_depth + 3 if pipeline_depth > 0 else 2)
+
         # Pipelined dispatch (device-resident running chain): the host
         # folds mutations it makes between launches into a per-launch
         # delta upload.  _pipe_adj accumulates signed running
@@ -189,6 +250,17 @@ class TaskDispatcher:
         self._pipe_resets: Dict[int, int] = {}
         self._pipe_reset_barrier = np.full(max_servants, -1, np.int64)
         self._pipe_launch_seq = 0
+
+        # Inline-leader dispatch: the first waiter of an idle backlog
+        # runs the cycle on its own thread (two condvar handoffs and
+        # the batch window fall off the lone-request latency path);
+        # concurrent arrivals coalesce into the leader's cycle.  Only
+        # in sync mode with a live dispatch thread — manual-cycle tests
+        # and benches (start_dispatch_thread=False) keep the invariant
+        # that no cycle runs unless they run one.
+        self._inline_dispatch = bool(
+            start_dispatch_thread and not self._pipelined)
+        self._inline_busy = False
 
         self._thread: Optional[threading.Thread] = None
         if start_dispatch_thread:
@@ -208,33 +280,88 @@ class TaskDispatcher:
         """Upsert a servant; expires_in_s <= 0 is a graceful leave
         (reference scheduler_service_impl.cc:164-170).  Returns False
         when the registry is full and the servant was NOT registered —
-        the caller must surface that as a heartbeat failure."""
-        with self._lock:
-            slot = self._by_location.get(info.location)
-            if expires_in_s <= 0:
+        the caller must surface that as a heartbeat failure.
+
+        Steady-state renewals of a known servant are STAGED (leaf lock
+        only) and batch-applied at the next dispatch cycle, expiration
+        sweep, or flush threshold; joins and leaves stay synchronous so
+        registration outcomes and registry-full are reported truthfully
+        on the beat that caused them."""
+        if expires_in_s <= 0:
+            with self._lock:
+                with self._hb_lock:
+                    # A staged renewal applied later must not resurrect
+                    # a servant that has gracefully left.
+                    self._hb_staged.pop(info.location, None)
+                slot = self._by_location.get(info.location)
                 if slot is not None:
                     self._drop_servant_locked(slot)
                     self._work.notify_all()
                 return True
-            if slot is None:
-                if not self._free_slots:
-                    logger.warning("servant registry full; rejecting %s",
-                                   info.location)
-                    return False
-                slot = self._free_slots.pop()
-                self._slots[slot] = _Servant(slot=slot, info=info)
-                self._by_location[info.location] = slot
-                self._slot_generation[slot] += 1
-                ip = info.location.rsplit(":", 1)[0]
-                self._by_ip.setdefault(ip, set()).add(slot)
-            servant = self._slots[slot]
-            servant.info = info
-            servant.expires_at = self._clock.now() + expires_in_s
-            for digest in info.env_digests:
-                self._envs.intern(digest)
-            self._refresh_slot_arrays_locked(slot, envs_too=True)
-            self._work.notify_all()
+        # Benign unlocked read: a concurrent drop just means the staged
+        # beat re-joins at flush time (the servant IS alive — it beat).
+        if info.location in self._by_location:
+            expires_at = self._clock.now() + expires_in_s
+            with self._hb_lock:
+                self._hb_staged[info.location] = (info, expires_at)
+                n_staged = len(self._hb_staged)
+            if n_staged >= _HB_FLUSH_THRESHOLD:
+                with self._lock:
+                    if self._flush_heartbeats_locked():
+                        self._work.notify_all()
             return True
+        with self._lock:
+            ok = self._apply_heartbeat_locked(
+                info, self._clock.now() + expires_in_s)
+            if ok:
+                self._work.notify_all()
+            return ok
+
+    def _apply_heartbeat_locked(self, info: ServantInfo,
+                                expires_at: float) -> bool:
+        slot = self._by_location.get(info.location)
+        if slot is not None and info == self._slots[slot].info:
+            # Steady-state beat repeating the previous report: a pure
+            # lease renewal.  Skipping the array refresh keeps batch
+            # flushes (up to _HB_FLUSH_THRESHOLD applies inside one
+            # dispatch cycle's setup) off the stage budget — at 5k
+            # beats/s virtually every flush is all-renewals.
+            self._slots[slot].expires_at = expires_at
+            return True
+        if slot is None:
+            if not self._free_slots:
+                logger.warning("servant registry full; rejecting %s",
+                               info.location)
+                return False
+            slot = self._free_slots.pop()
+            self._slots[slot] = _Servant(slot=slot, info=info)
+            self._by_location[info.location] = slot
+            self._slot_generation[slot] += 1
+            ip = info.location.rsplit(":", 1)[0]
+            self._by_ip.setdefault(ip, set()).add(slot)
+        servant = self._slots[slot]
+        servant.info = info
+        servant.expires_at = expires_at
+        for digest in info.env_digests:
+            self._envs.intern(digest)
+        self._refresh_slot_arrays_locked(slot, envs_too=True)
+        return True
+
+    def _flush_heartbeats_locked(self) -> int:
+        """Apply every staged heartbeat; returns how many applied.
+        Lock order: main -> hb (staging alone takes only hb)."""
+        with self._hb_lock:
+            if not self._hb_staged:
+                return 0
+            staged = self._hb_staged
+            self._hb_staged = {}
+        for info, expires_at in staged.values():
+            # A servant dropped (lease sweep) after its beat was staged
+            # re-joins here; registry-full at that point is only logged
+            # — the servant's next beat takes the synchronous join path
+            # and surfaces the error.
+            self._apply_heartbeat_locked(info, expires_at)
+        return len(staged)
 
     def notify_servant_running_tasks(
         self, location: str, reported_grant_ids: Sequence[int]
@@ -292,6 +419,7 @@ class TaskDispatcher:
         if env_id is None:
             return []
         with self._lock:
+            now = self._clock.now()
             req = _Pending(
                 env_id=env_id,
                 env_digest=env_digest,
@@ -301,13 +429,30 @@ class TaskDispatcher:
                 lease_s=lease_s,
                 immediate_left=max(0, immediate),
                 prefetch_left=max(0, prefetch),
-                deadline=self._clock.now() + timeout_s,
+                deadline=now + timeout_s,
+                enqueued_at=now,
             )
             if req.immediate_left + req.prefetch_left == 0:
                 return []
             self._pending.append(req)
             self._work.notify_all()
-        req.done.wait(timeout=timeout_s + 1.0)
+            lead = self._inline_dispatch and not self._inline_busy
+            if lead:
+                self._inline_busy = True
+        if lead:
+            # Inline-leader fast path: resolve the backlog on THIS
+            # thread (any requests that arrived meanwhile ride the same
+            # cycle).  Unsatisfied remainders fall back to the dispatch
+            # thread, which was notified above.
+            try:
+                self._run_cycle()
+            except Exception:
+                logger.exception("inline dispatch cycle failed")
+            finally:
+                with self._lock:
+                    self._inline_busy = False
+        if not req.done.is_set():
+            req.done.wait(timeout=timeout_s + 1.0)
         with self._lock:
             # From here on a racing apply phase must not issue us grants
             # we'd never see (they would leak the servant's capacity).
@@ -353,6 +498,8 @@ class TaskDispatcher:
         orphan-sweep grants on dead servants."""
         now = self._clock.now()
         with self._lock:
+            # Staged renewals land before the sweep judges leases.
+            self._flush_heartbeats_locked()
             for slot, servant in enumerate(self._slots):
                 if servant is not None and servant.expires_at <= now:
                     self._drop_servant_locked(slot)
@@ -424,49 +571,81 @@ class TaskDispatcher:
                     self._work.wait(timeout=0.25)
 
     def _run_cycle(self) -> int:
-        """One policy pass over the backlog; returns grants issued."""
-        with self._lock:
-            now = self._clock.now()
-            self._expire_pending_locked(now)
-            if not self._pending:
-                return 0
-            snap = self._snapshot_locked()
-            snap_generation = self._slot_generation.copy()
-            work: List[Tuple[_Pending, bool]] = []  # (request, is_prefetch)
-            for req in self._pending:
-                for _ in range(req.immediate_left):
-                    work.append((req, False))
-                if not req.first_cycle_done:
-                    for _ in range(req.prefetch_left):
-                        work.append((req, True))
-            reqs = [
-                AssignRequest(r.env_id, r.min_version, r.requestor_slot)
-                for r, _ in work
-            ]
-        if not reqs:
-            return 0
+        """One policy pass over the backlog; returns grants issued.
 
-        picks = self._policy.assign(snap, reqs)
+        Stage accounting (injectable clock; see utils/stagetimer.py):
+        `snapshot` covers cycle setup under the lock (staged-heartbeat
+        flush, deadline sweep, work-list build, prepared-snapshot
+        publication), `policy` the kernel outside the lock, `apply` the
+        locked validation/issue pass — the three sum exactly to
+        `dispatch_cycle` (same timestamps), and each request's time
+        from enqueue to its first cycle is `queue_wait`."""
+        clock = self._clock
+        snap = None
+        try:
+            with self._lock:
+                t0 = clock.now()
+                self._flush_heartbeats_locked()
+                self._expire_pending_locked(t0)
+                if not self._pending:
+                    return 0
+                work: List[Tuple[_Pending, bool]] = []  # (req, is_prefetch)
+                queue_waits: List[float] = []
+                for req in self._pending:
+                    if not req.queue_wait_recorded:
+                        req.queue_wait_recorded = True
+                        queue_waits.append(t0 - req.enqueued_at)
+                    for _ in range(req.immediate_left):
+                        work.append((req, False))
+                    if not req.first_cycle_done:
+                        for _ in range(req.prefetch_left):
+                            work.append((req, True))
+                if not work:
+                    return 0
+                snap = self._snapshot_locked()
+                snap_generation = self._slot_generation.copy()
+                reqs = [
+                    AssignRequest(r.env_id, r.min_version, r.requestor_slot)
+                    for r, _ in work
+                ]
+                t1 = clock.now()
 
-        issued = 0
-        cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
-        with self._lock:
-            now = self._clock.now()
-            for (req, is_prefetch), pick in zip(work, picks):
-                if self._try_issue_locked(req, is_prefetch, int(pick),
-                                          snap_generation, cap_cache,
-                                          now):
-                    issued += 1
-            # Prefetch never waits — but only for requests that actually
-            # participated in this cycle; one that arrived mid-assign
-            # keeps its prefetch for the next cycle.
-            participated = {id(r) for r, _ in work}
-            for req in self._pending:
-                if id(req) in participated:
-                    req.first_cycle_done = True
-                    req.prefetch_left = 0
-            self._finish_satisfied_locked(self._clock.now())
-        return issued
+            picks = self._policy.assign(snap, reqs)
+            t2 = clock.now()
+
+            issued = 0
+            cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
+            with self._lock:
+                self._release_snapshot_locked(snap)
+                snap = None
+                now = clock.now()
+                for (req, is_prefetch), pick in zip(work, picks):
+                    if self._try_issue_locked(req, is_prefetch, int(pick),
+                                              snap_generation, cap_cache,
+                                              now):
+                        issued += 1
+                # Prefetch never waits — but only for requests that
+                # actually participated in this cycle; one that arrived
+                # mid-assign keeps its prefetch for the next cycle.
+                participated = {id(r) for r, _ in work}
+                for req in self._pending:
+                    if id(req) in participated:
+                        req.first_cycle_done = True
+                        req.prefetch_left = 0
+                self._finish_satisfied_locked(clock.now())
+            t3 = clock.now()
+            timer = self.stage_timer
+            for qw in queue_waits:
+                timer.record("queue_wait", qw)
+            timer.record("snapshot", t1 - t0)
+            timer.record("policy", t2 - t1)
+            timer.record("apply", t3 - t2)
+            timer.record("dispatch_cycle", t3 - t0)
+            return issued
+        finally:
+            if snap is not None:
+                with self._lock:
+                    self._release_snapshot_locked(snap)
 
     def _try_issue_locked(self, req, is_prefetch: bool, pick: int,
                           snap_generation, cap_cache, now: float,
@@ -479,6 +658,11 @@ class TaskDispatcher:
         if pick == NO_PICK:
             return None
         if req.abandoned:
+            return False
+        # Concurrent cycles (inline leader + dispatch thread) may both
+        # carry work entries for the same request; the counters gate so
+        # a request is never over-granted.
+        if (req.prefetch_left if is_prefetch else req.immediate_left) <= 0:
             return False
         servant = self._slots[pick] if pick < len(self._slots) else None
         if servant is None:
@@ -522,6 +706,7 @@ class TaskDispatcher:
         self._grants[g.grant_id] = g
         servant.running_grants.add(g.grant_id)
         self._arr_running[pick] += 1
+        self._mark_slot_dirty_locked(pick)
         req.grants.append(g)
         if is_prefetch:
             # Clamped: a drained earlier ticket may already have zeroed
@@ -565,11 +750,13 @@ class TaskDispatcher:
                     # (Re)seed the chain from host truth — at startup,
                     # and after any device error.  Failures here retry
                     # through the same except path; granting must never
-                    # die silently with the thread.
+                    # die silently with the thread.  Full-copy snapshot:
+                    # reseeds are rare and the copy's lifetime is the
+                    # policy's to manage (device uploads may be async).
                     with self._lock:
                         if self._stopping:
                             break
-                        snap = self._snapshot_locked()
+                        snap = self._snapshot_full_locked()
                         self._pipe_active = True
                         self._pipe_adj[:] = 0
                         self._pipe_resets.clear()
@@ -617,7 +804,11 @@ class TaskDispatcher:
                 work, descr, snap, gen, adj, resets, lid = launch
                 ticket = policy.stream_launch(snap, descr, adj, resets)
                 launch = None          # appended below: rollback claim ends
-                tickets.append((ticket, work, gen, lid))
+                # The prepared-snapshot lease rides the ticket: the
+                # launch's device uploads may still be reading the
+                # buffer asynchronously, so it is only released when
+                # the ticket drains (or rolls back).
+                tickets.append((ticket, work, gen, lid, snap))
                 failures = 0
             except Exception:
                 # A device error mid-stream poisons the running chain:
@@ -627,9 +818,12 @@ class TaskDispatcher:
                 logger.exception(
                     "pipelined dispatch cycle failed; resyncing stream")
                 with self._lock:
-                    rollbacks = [w for _, w, _, _ in tickets]
+                    rollbacks = [w for _, w, _, _, _ in tickets]
+                    for _, _, _, _, s in tickets:
+                        self._release_snapshot_locked(s)
                     if launch is not None:   # the launch itself failed
                         rollbacks.append(launch[0])
+                        self._release_snapshot_locked(launch[2])
                     for work in rollbacks:
                         for req, is_prefetch in work:
                             if is_prefetch:
@@ -693,7 +887,12 @@ class TaskDispatcher:
         excluded; prefetch is all-or-nothing (it is opportunistic and
         must never outlive the first cycle)."""
         now = self._clock.now()
+        self._flush_heartbeats_locked()
         self._expire_pending_locked(now)
+        for req in self._pending:
+            if not req.queue_wait_recorded:
+                req.queue_wait_recorded = True
+                self.stage_timer.record("queue_wait", now - req.enqueued_at)
         max_groups = getattr(self._policy, "_max_groups", 64)
         task_cap = getattr(self._policy, "_TASK_CAP", 2048)
         work: List[Tuple[_Pending, bool]] = []
@@ -736,7 +935,9 @@ class TaskDispatcher:
                 break
         if not work:
             return None
+        t_snap = self._clock.now()
         snap = self._snapshot_locked()
+        self.stage_timer.record("snapshot", self._clock.now() - t_snap)
         gen = self._slot_generation.copy()
         adj = self._pipe_adj.copy()
         self._pipe_adj[:] = 0
@@ -749,14 +950,18 @@ class TaskDispatcher:
         return (work, [tuple(d) for d in descr], snap, gen, adj,
                 resets, lid)
 
-    def _drain_ticket(self, ticket, work, snap_generation, lid) -> int:
+    def _drain_ticket(self, ticket, work, snap_generation, lid,
+                      snap=None) -> int:
         """Apply one completed launch: validate each pick against
         current state, issue grants, and convert host rejections into
         running-chain corrections for the next launch."""
+        t0 = self._clock.now()
         picks = self._policy.stream_collect(ticket)
         issued = 0
         cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
         with self._lock:
+            if snap is not None:
+                self._release_snapshot_locked(snap)
             now = self._clock.now()
             for (req, is_prefetch), pick in zip(work, picks):
                 if is_prefetch:
@@ -787,6 +992,7 @@ class TaskDispatcher:
                         req.prefetch_left = 0
             self._finish_satisfied_locked(self._clock.now())
             self._work.notify_all()
+        self.stage_timer.record("apply", self._clock.now() - t0)
         return issued
 
     # ------------------------------------------------------------------
@@ -832,6 +1038,7 @@ class TaskDispatcher:
         production scenario it exists for."""
         servant = self._slots[slot]
         if servant is None:
+            self._mark_slot_dirty_locked(slot)
             if self._arr_alive[slot]:
                 self._pool_epoch += 1
             self._arr_alive[slot] = False
@@ -846,13 +1053,27 @@ class TaskDispatcher:
             self._arr_env[slot] = 0
             return
         info = servant.info
+        mem_ok = info.memory_available >= self._min_memory
+        accepting = info.not_accepting_reason == 0
+        n_running = len(servant.running_grants)
+        # Steady-state beats mostly repeat the previous report; the
+        # prepared snapshot buffers are only dirtied on a REAL change,
+        # otherwise a 5k/s fleet re-dirties the whole pool every sweep
+        # and every snapshot degenerates to a full rebuild.
+        dyn_changed = (
+            int(self._arr_cap_rep[slot]) != info.capacity
+            or int(self._arr_nprocs[slot]) != info.num_processors
+            or int(self._arr_load[slot]) != info.current_load
+            or bool(self._arr_mem_ok[slot]) != mem_ok
+            or bool(self._arr_accepting[slot]) != accepting
+            or int(self._arr_running[slot]) != n_running)
         # Re-uploaded every cycle (capacity/running vectors): no epoch.
         self._arr_cap_rep[slot] = info.capacity
         self._arr_nprocs[slot] = info.num_processors
         self._arr_load[slot] = info.current_load
-        self._arr_mem_ok[slot] = info.memory_available >= self._min_memory
-        self._arr_accepting[slot] = info.not_accepting_reason == 0
-        self._arr_running[slot] = len(servant.running_grants)
+        self._arr_mem_ok[slot] = mem_ok
+        self._arr_accepting[slot] = accepting
+        self._arr_running[slot] = n_running
         # Device-cached statics: epoch bump only on change.
         changed = (not self._arr_alive[slot]
                    or bool(self._arr_dedicated[slot]) != info.dedicated
@@ -871,6 +1092,8 @@ class TaskDispatcher:
                 self._arr_env[slot] = row
         if changed:
             self._pool_epoch += 1
+        if changed or dyn_changed:
+            self._mark_slot_dirty_locked(slot)
 
     def _effective_capacity_locked(self, servant: _Servant) -> int:
         """Reference GetCapacityAvailable (task_dispatcher.cc:283-313):
@@ -886,17 +1109,30 @@ class TaskDispatcher:
         )
         return max(0, min(info.capacity, info.num_processors - foreign_load))
 
-    def _snapshot_locked(self) -> PoolSnapshot:
-        # Effective capacity, vectorized (the per-servant semantics of
-        # _effective_capacity_locked): zero unless accepting with
-        # enough memory, else min(reported, nprocs - foreign load).
+    def _mark_slot_dirty_locked(self, slot: int) -> None:
+        for buf in self._snap_buffers:
+            buf.dirty.add(slot)
+
+    def _effective_capacity_at_locked(self, idx: np.ndarray) -> np.ndarray:
+        """Vectorized _effective_capacity_locked over a slot index
+        vector: zero unless accepting with enough memory, else
+        min(reported, nprocs - foreign load)."""
+        foreign = np.maximum(self._arr_load[idx] - self._arr_running[idx], 0)
+        effective = np.minimum(self._arr_cap_rep[idx],
+                               self._arr_nprocs[idx] - foreign)
+        return np.where(self._arr_accepting[idx] & self._arr_mem_ok[idx],
+                        np.maximum(effective, 0), 0).astype(np.int32)
+
+    def _snapshot_full_locked(self) -> PoolSnapshot:
+        """From-scratch snapshot: six full-array copies under the lock.
+        Kept as the fallback when every prepared buffer is leased and
+        as the oracle the incremental path is equivalence-tested
+        against (tests/test_latency_breakdown.py)."""
         foreign = np.maximum(self._arr_load - self._arr_running, 0)
         effective = np.minimum(self._arr_cap_rep,
                                self._arr_nprocs - foreign)
         effective = np.where(self._arr_accepting & self._arr_mem_ok,
                              np.maximum(effective, 0), 0).astype(np.int32)
-        # Copies: the policy runs outside the lock while heartbeats
-        # keep mutating the live arrays.
         return PoolSnapshot(
             self._arr_alive.copy(),
             effective,
@@ -906,6 +1142,60 @@ class TaskDispatcher:
             self._arr_env.copy(),
             epoch=self._pool_epoch,
         )
+
+    def _snapshot_locked(self) -> PoolSnapshot:
+        """Publish the prepared snapshot: bring one double-buffer up to
+        date by touching ONLY the slots dirtied since that buffer last
+        published (heartbeats, grants, frees, drops), instead of
+        copying six pool arrays per cycle — at a 5-8k-slot pool the
+        old full copy (env bitmap included) moved ~0.5MB under the
+        dispatcher lock every cycle.  The returned snapshot's arrays
+        are read-only until released (_release_snapshot_locked); the
+        buffer is only mutated here, under the lock, while unleased."""
+        buf = next((b for b in self._snap_buffers if not b.leased), None)
+        if buf is None:
+            if len(self._snap_buffers) >= self._max_snap_buffers:
+                # Every buffer is in flight (deep pipeline): fall back
+                # to a one-off full copy rather than grow unboundedly.
+                return self._snapshot_full_locked()
+            buf = _SnapBuffer(self.max_servants, self._env_words)
+            self._snap_buffers.append(buf)
+        s = self.max_servants
+        if buf.full_rebuild or len(buf.dirty) * _SNAP_FULL_REBUILD_FRAC > s:
+            np.copyto(buf.alive, self._arr_alive)
+            foreign = np.maximum(self._arr_load - self._arr_running, 0)
+            effective = np.minimum(self._arr_cap_rep,
+                                   self._arr_nprocs - foreign)
+            np.copyto(buf.capacity,
+                      np.where(self._arr_accepting & self._arr_mem_ok,
+                               np.maximum(effective, 0), 0))
+            np.copyto(buf.running, self._arr_running)
+            np.copyto(buf.dedicated, self._arr_dedicated)
+            np.copyto(buf.version, self._arr_version)
+            np.copyto(buf.env, self._arr_env)
+            buf.full_rebuild = False
+        elif buf.dirty:
+            idx = np.fromiter(buf.dirty, np.int64, len(buf.dirty))
+            buf.alive[idx] = self._arr_alive[idx]
+            buf.capacity[idx] = self._effective_capacity_at_locked(idx)
+            buf.running[idx] = self._arr_running[idx]
+            buf.dedicated[idx] = self._arr_dedicated[idx]
+            buf.version[idx] = self._arr_version[idx]
+            buf.env[idx] = self._arr_env[idx]
+        buf.dirty.clear()
+        buf.leased = True
+        snap = PoolSnapshot(
+            buf.alive, buf.capacity, buf.running, buf.dedicated,
+            buf.version, buf.env, epoch=self._pool_epoch,
+        )
+        snap._snap_buf = buf  # type: ignore[attr-defined]
+        return snap
+
+    def _release_snapshot_locked(self, snap: PoolSnapshot) -> None:
+        buf = getattr(snap, "_snap_buf", None)
+        if buf is not None:
+            buf.leased = False
+            snap._snap_buf = None  # type: ignore[attr-defined]
 
     def _drop_servant_locked(self, slot: int) -> None:
         servant = self._slots[slot]
@@ -941,6 +1231,7 @@ class TaskDispatcher:
             if g.grant_id in servant.running_grants:
                 servant.running_grants.discard(g.grant_id)
                 self._arr_running[g.slot] -= 1
+                self._mark_slot_dirty_locked(g.slot)
                 if self._pipe_active:
                     # The device running chain counted this grant (it
                     # was issued through a drained launch); stream the
@@ -958,6 +1249,7 @@ class TaskDispatcher:
 
     def inspect(self) -> dict:
         with self._lock:
+            self._flush_heartbeats_locked()
             servants = {}
             for servant in self._slots:
                 if servant is None:
@@ -986,4 +1278,7 @@ class TaskDispatcher:
                 "pending_requests": len(self._pending),
                 "stats": dict(self._stats),
                 "envs_interned": len(self._envs),
+                # Grant-path stage percentiles (doc/scheduler.md,
+                # "Grant-path stage budget").
+                "latency_breakdown": self.stage_timer.percentiles(),
             }
