@@ -1,11 +1,22 @@
 # Top-level convenience targets.  `make check` is the cold-clone gate
-# (native build + tier-1 pytest) that mirrors the reference's per-push
-# CI (yadcc .github/workflows/build-and-test.yml) — see tools/ci.sh.
+# (lint + native build + tier-1 pytest) that mirrors the reference's
+# per-push CI (yadcc .github/workflows/build-and-test.yml) — see
+# tools/ci.sh.  `make lint` is the static tier alone: the
+# concurrency/jit analyzer (doc/static_analysis.md) plus shellcheck
+# over the ops scripts where the tool is installed.
 
-.PHONY: check native clean
+.PHONY: check lint native clean
 
 check:
 	bash tools/ci.sh
+
+lint:
+	python -m yadcc_tpu.analysis yadcc_tpu
+	@if command -v shellcheck >/dev/null 2>&1; then \
+	  shellcheck tools/*.sh; \
+	else \
+	  echo "shellcheck not installed; skipping shell lint"; \
+	fi
 
 native:
 	$(MAKE) -C native

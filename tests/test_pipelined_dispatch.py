@@ -156,7 +156,24 @@ class TestPipelinedChurn:
     def test_chain_survives_churn(self):
         """Waiters, frees, servant death, slot recycling and request
         timeouts racing against the pipeline; the chain invariant must
-        hold once quiescent."""
+        hold once quiescent.
+
+        Runs under lock-order tracing (the always-on YTPU_LOCKTRACE
+        tier wired into the tier-1 stress fixtures): every dispatcher
+        lock constructed during the churn is traced and the order
+        graph must stay cycle-free among framework locks.  jax's own
+        locks (the device policy compiles inside the window) are
+        traced too but filtered — their internal ordering is not this
+        repo's gate."""
+        from yadcc_tpu.utils import locktrace
+
+        with locktrace.installed() as lock_graph:
+            self._churn_body()
+        bad = locktrace.framework_violations(lock_graph)
+        assert bad == [], f"lock-order violations under pipelined " \
+                          f"churn: {bad}"
+
+    def _churn_body(self):
         policy = JaxGroupedPolicy(max_groups=8)
         d = make_dispatcher(4, n_servants=12, capacity=3, policy=policy)
         stop = threading.Event()

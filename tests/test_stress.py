@@ -23,6 +23,7 @@ import pytest
 from yadcc_tpu.models.cost import DispatchCostModel
 from yadcc_tpu.scheduler.policy import GreedyCpuPolicy, JaxGroupedPolicy
 from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+from yadcc_tpu.utils import locktrace
 from yadcc_tpu.utils.clock import VirtualClock
 
 ENVS = [f"env-{i:02d}" for i in range(6)]
@@ -42,7 +43,26 @@ def servant_info(i: int) -> ServantInfo:
 
 def _run_churn_storm(policy_name: str, *, n_servants: int = 60,
                      ticks: int = 40, max_servants: int = 128) -> dict:
-    """Shared storm body; returns the final inspect() dict."""
+    """Shared storm body; returns the final inspect() dict.
+
+    Runs under lock-order tracing unconditionally (the always-on
+    YTPU_LOCKTRACE tier for CI): every lock the dispatcher constructs
+    during the storm is traced and the cross-thread order graph must
+    come out cycle-free among framework locks — not just in
+    test_locktrace.py's dedicated run, but on every tier-1 execution
+    of this fixture."""
+    with locktrace.installed() as lock_graph:
+        snap = _run_churn_storm_traced(policy_name,
+                                       n_servants=n_servants,
+                                       ticks=ticks,
+                                       max_servants=max_servants)
+    bad = locktrace.framework_violations(lock_graph)
+    assert bad == [], f"lock-order violations under churn: {bad}"
+    return snap
+
+
+def _run_churn_storm_traced(policy_name: str, *, n_servants: int,
+                            ticks: int, max_servants: int) -> dict:
     policy = {
         "greedy_cpu": lambda: GreedyCpuPolicy(DispatchCostModel()),
         "jax_grouped": lambda: JaxGroupedPolicy(max_groups=8),

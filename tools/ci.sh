@@ -10,9 +10,28 @@
 #                           headers on the box; the python suite skips
 #                           its native-client tests on its own).
 set -u -o pipefail
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 
 fail=0
+
+echo "== lint (ytpu-analyze + shellcheck) =="
+# The static concurrency/jit analyzer must come back clean — zero
+# unsuppressed findings over the package (doc/static_analysis.md).
+if ! python -m yadcc_tpu.analysis yadcc_tpu; then
+  echo "ytpu-analyze FAILED" >&2
+  fail=1
+fi
+# Shell hygiene for the ops scripts.  Boxes without shellcheck (this
+# harness included) skip with a notice; the gate still runs wherever
+# the tool exists, so a regression fails CI on any equipped machine.
+if command -v shellcheck >/dev/null 2>&1; then
+  if ! shellcheck tools/*.sh; then
+    echo "shellcheck FAILED" >&2
+    fail=1
+  fi
+else
+  echo "shellcheck not installed; skipping shell lint" >&2
+fi
 
 if [ "${YTPU_CI_SKIP_NATIVE:-}" != 1 ]; then
   echo "== native build =="

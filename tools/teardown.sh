@@ -35,6 +35,7 @@ done
 
 is_kept() {
   local pid
+  # shellcheck disable=SC2086  # KEEP is a deliberately split pid list
   for pid in $KEEP; do
     [ "$1" = "$pid" ] && return 0
   done
@@ -56,6 +57,7 @@ kill_matching() {
   # $1: pgrep -f pattern (further scoped by is_ours)
   local pids pid
   pids=$(pgrep -f "$1" 2>/dev/null) || return 0
+  # shellcheck disable=SC2086  # splitting the pgrep output is the point
   for pid in $pids; do
     is_kept "$pid" && continue
     is_ours "$pid" || continue
@@ -64,6 +66,7 @@ kill_matching() {
   # Grace, then force anything still alive.
   sleep 1
   pids=$(pgrep -f "$1" 2>/dev/null) || return 0
+  # shellcheck disable=SC2086
   for pid in $pids; do
     is_kept "$pid" && continue
     is_ours "$pid" || continue

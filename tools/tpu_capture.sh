@@ -8,7 +8,7 @@
 # report (VERDICT r3 next-round #1).
 # Usage: tools/tpu_capture.sh [max_wait_minutes]
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 MAX_MIN=${1:-360}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-180}
 BENCH_TIMEOUT=${BENCH_TIMEOUT:-1800}
@@ -44,10 +44,11 @@ recover() {
   # PJRT holder that chdir'd away or daemonized to / was previously
   # skipped silently and the tunnel never reclaimed; ADVICE r5 low#2).
   local pids pid mypg pg cwd ours
-  mypg=$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')
+  mypg=$(ps -o pgid= -p "$$" 2>/dev/null | tr -d ' ')
   pids=$(pgrep -f 'yadcc_tpu\.(scheduler|cache|daemon)\.entry' \
          ; pgrep -f 'ytpu_probe_marker' \
          ; pgrep -f 'BENCH_CHILD=1') || true
+  # shellcheck disable=SC2086  # word splitting of the pid list is the point
   for pid in $pids; do
     [ "$pid" = "$$" ] && continue
     pg=$(ps -o pgid= -p "$pid" 2>/dev/null | tr -d ' ')

@@ -1,0 +1,42 @@
+"""ytpu-analyze: AST-based concurrency & jit-discipline analyzer.
+
+The reference keeps concurrency honest by convention (`Unsafe*` naming
+for lock-held methods, a documented lock ordering,
+task_dispatcher.h:226-268, and gperftools strict heap checking baked
+into every test run, BLADE_ROOT:25-33).  Our port replicates the
+conventions — `*_locked` method suffixes, leaf locks, a runtime
+lock-order tracer (utils/locktrace.py) — but until this package nothing
+checked them *statically*: a guarded field touched outside its lock or
+a device sync under the dispatcher lock only surfaced if a stress test
+happened to hit the interleaving.  This is the lint-time tier
+(`python -m yadcc_tpu.analysis yadcc_tpu`, `make lint`): a TSan-style
+static pass over the package's own source.
+
+Rule families (doc/static_analysis.md has the full catalog):
+
+* ``guarded-by`` / ``locked-call`` — attributes declared via
+  ``# guarded by: self._lock`` trailing comments may only be touched
+  while that lock is held (a ``with self._lock:`` block, a Condition
+  constructed over it, or a ``*_locked`` method, which by convention
+  runs with the class's primary lock held); ``self.*_locked()`` calls
+  require the lock too.
+* ``lock-order`` — nested ``with`` acquisitions are extracted as
+  edges and checked against the declared hierarchy
+  (analysis/lock_hierarchy.toml); complements the runtime locktrace,
+  which sees cross-function/cross-class orderings this pass cannot.
+* ``block-under-lock`` — sleeps, file/socket I/O, RPC calls, device
+  sync / jnp dispatch inside a lock body in scheduler/ and daemon/
+  hot paths (the sub-2ms grant budget leaves no room for any of them).
+* ``jit-nondet`` / ``jit-tracer-if`` / ``jit-static-unhashable`` —
+  jit hygiene inside ``@jax.jit`` functions in ops/ and parallel/.
+
+Findings carry rule id + file:line and honor
+``# ytpu: allow(<rule>)  # reason`` suppressions (a suppression
+without a written reason is itself a finding).
+"""
+
+from __future__ import annotations
+
+from .core import AnalyzerConfig, Finding, analyze_paths
+
+__all__ = ["AnalyzerConfig", "Finding", "analyze_paths"]

@@ -1,0 +1,98 @@
+"""CLI: ``python -m yadcc_tpu.analysis [paths...]``.
+
+Exit status: 0 = clean (no unsuppressed findings), 1 = findings,
+2 = usage error.  ``make lint`` runs this over ``yadcc_tpu/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import minitoml
+from .core import RULES, AnalyzerConfig, analyze_paths
+
+_DEFAULT_HIERARCHY = os.path.join(os.path.dirname(__file__),
+                                  "lock_hierarchy.toml")
+
+
+def _load_ranks(path: str) -> dict:
+    doc = minitoml.load_path(path)
+    ranks = doc.get("rank", {})
+    bad = {k: v for k, v in ranks.items() if not isinstance(v, int)}
+    if bad:
+        raise minitoml.MiniTomlError(
+            f"[rank] values must be integers: {sorted(bad)}")
+    return dict(ranks)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m yadcc_tpu.analysis",
+        description="AST-based concurrency & jit-discipline analyzer "
+                    "(doc/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["yadcc_tpu"],
+                    help="files or directories to analyze "
+                         "(default: yadcc_tpu)")
+    ap.add_argument("--hierarchy", default=_DEFAULT_HIERARCHY,
+                    help="lock hierarchy TOML (default: the package's "
+                         "lock_hierarchy.toml)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the findings report to this path")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--strict-suppressions", action="store_true",
+                    help="fail on suppressions that matched nothing")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:24s} {desc}")
+        return 0
+
+    try:
+        ranks = _load_ranks(args.hierarchy)
+    except (OSError, minitoml.MiniTomlError) as e:
+        print(f"cannot load lock hierarchy {args.hierarchy}: {e}",
+              file=sys.stderr)
+        return 2
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    config = AnalyzerConfig(
+        lock_ranks=ranks,
+        strict_suppressions=args.strict_suppressions)
+    findings, stats = analyze_paths(args.paths, config)
+
+    shown = 0
+    for f in findings:
+        if f.suppressed and not args.show_suppressed:
+            continue
+        tag = " (suppressed)" if f.suppressed else ""
+        print(f.render() + tag)
+        shown += 1
+    print(f"ytpu-analyze: {stats['files_analyzed']} files, "
+          f"{stats['findings']} finding(s), "
+          f"{stats['suppressed']} suppressed")
+
+    if args.json_out:
+        report = {
+            "version": 1,
+            "stats": stats,
+            "findings": [f.as_dict() for f in findings],
+        }
+        with open(args.json_out, "w", encoding="utf-8") as fp:
+            json.dump(report, fp, indent=2, sort_keys=True)
+            fp.write("\n")
+
+    return 1 if stats["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,553 @@
+"""Analyzer infrastructure: directives, lock discovery, held-lock walk.
+
+Everything here is shared by the rule families (lockrules.py,
+jit_hygiene.py): parsing ``# guarded by:`` / ``# ytpu: allow(...)``
+comments, discovering which attributes of a class are locks (and which
+Conditions wrap which locks), and walking a function body while
+tracking the set of locks statically known to be held.
+
+Scope and honesty notes (also in doc/static_analysis.md):
+
+* The walk is intraprocedural.  ``*_locked`` methods are assumed to
+  run with their class's *primary* lock held (``self._lock`` when the
+  class has one, else its only lock attribute) — that is exactly the
+  convention the suffix declares.  Cross-class and cross-function
+  acquisition chains are the runtime locktrace's job.
+* A nested ``def`` inherits the held set of its definition site.  For
+  the synchronous helper-closure idiom this is right; a closure stashed
+  and called later from another thread is invisible to this pass.
+* Lock acquisition is recognized on ``with`` statements only.  Raw
+  ``.acquire()``/``.release()`` pairs (the locktrace proxy internals)
+  are not tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "guarded-by": "guarded attribute accessed without its declared lock",
+    "locked-call": "*_locked method called from a site not holding the lock",
+    "lock-order": "nested lock acquisition undeclared or inverting the "
+                  "declared hierarchy (analysis/lock_hierarchy.toml)",
+    "block-under-lock": "blocking call (sleep / I/O / RPC / device sync) "
+                        "inside a lock body on a scheduler/daemon hot path",
+    "jit-nondet": "wall-clock or nondeterminism call inside a @jax.jit "
+                  "function",
+    "jit-tracer-if": "Python branch on a traced argument inside a "
+                     "@jax.jit function",
+    "jit-static-unhashable": "unhashable value bound to a static jit "
+                             "argument",
+    "suppression": "malformed suppression or suppression without a "
+                   "written reason",
+    "parse-error": "file could not be parsed",
+}
+
+# Factories whose call result is a lock / a condition.  Matched on the
+# last dotted segment so `threading.Lock`, bare `Lock` (from-import) and
+# locktrace's `_real_lock` all register.
+LOCK_FACTORIES = {"Lock", "allocate_lock", "_real_lock"}
+RLOCK_FACTORIES = {"RLock", "_real_rlock"}
+COND_FACTORIES = {"Condition"}
+
+# Methods in which unguarded access to guarded attributes is legal: the
+# object is not yet (or no longer) shared.
+CONSTRUCTION_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ytpu:\s*allow\(\s*([A-Za-z0-9_*,\- ]*)\s*\)\s*(.*)$")
+_GUARD_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z0-9_.\[\]'\"]+)\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed}
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Set[str]
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class AnalyzerConfig:
+    # Path fragments selecting the modules where block-under-lock
+    # applies (grant/compile hot paths; the cache server's disk engine
+    # legitimately does I/O under its own lock and stays out).
+    hot_path_fragments: Tuple[str, ...] = ("scheduler", "daemon")
+    # Path fragments selecting the modules where jit hygiene applies.
+    jit_path_fragments: Tuple[str, ...] = ("ops", "parallel")
+    # Lock hierarchy: canonical lock name -> rank (lower acquired
+    # first).  Loaded from lock_hierarchy.toml by the CLI.
+    lock_ranks: Dict[str, int] = field(default_factory=dict)
+    # Report suppressions that matched nothing (kept off the CI default:
+    # rule evolution must not turn a stale-but-documented allow into a
+    # gate failure).
+    strict_suppressions: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Directives (comment-level annotations).
+# ---------------------------------------------------------------------------
+
+
+class Directives:
+    """Per-file suppressions and guard declarations, by line number.
+
+    Guard comments are associated with an attribute by
+    build_module_model, which matches them against the line span of the
+    ``self.X = ...`` statement they sit on (so the comment may ride the
+    closing line of a multi-line assignment)."""
+
+    def __init__(self, source: str):
+        self.suppressions: Dict[int, Suppression] = {}
+        self.guards: Dict[int, str] = {}   # lineno -> lock expr
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "#" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                reason = m.group(2).strip().lstrip("#").strip()
+                self.suppressions[lineno] = Suppression(
+                    lineno, rules, reason)
+            g = _GUARD_RE.search(text)
+            if g:
+                self.guards[lineno] = g.group(1)
+
+    def suppression_for(self, line: int, rule: str) -> Optional[Suppression]:
+        s = self.suppressions.get(line)
+        if s is None:
+            return None
+        if rule in s.rules or "*" in s.rules:
+            return s
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Lock discovery.
+# ---------------------------------------------------------------------------
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def root_segment(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class LockRef:
+    key: str                      # canonical name ("TaskDispatcher._lock")
+    expr: str                     # source form at the site ("self._lock")
+    kind: str                     # "lock" | "rlock" | "cond"
+    underlying: Optional["LockRef"] = None   # the lock a Condition wraps
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    lineno: int
+    end_lineno: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    cond_aliases: Dict[str, Optional[str]] = field(default_factory=dict)
+    guards: Dict[str, str] = field(default_factory=dict)  # attr -> lock expr
+
+    @property
+    def primary_lock_attr(self) -> Optional[str]:
+        """The lock `*_locked` methods are assumed to hold: `_lock` if
+        present, else the class's only non-Condition lock attribute."""
+        if "_lock" in self.lock_attrs:
+            return "_lock"
+        plain = [a for a, k in self.lock_attrs.items() if k != "cond"]
+        if len(plain) == 1:
+            return plain[0]
+        return None
+
+    def lock_ref_for_attr(self, attr: str, owner: str = "self"
+                          ) -> Optional[LockRef]:
+        kind = self.lock_attrs.get(attr)
+        if kind is None:
+            return None
+        ref = LockRef(key=f"{self.name}.{attr}", expr=f"{owner}.{attr}",
+                      kind=kind)
+        if kind == "cond":
+            under = self.cond_aliases.get(attr)
+            if under and under in self.lock_attrs:
+                ref.underlying = LockRef(
+                    key=f"{self.name}.{under}", expr=f"{owner}.{under}",
+                    kind=self.lock_attrs[under])
+        return ref
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    relpath: str
+    modname: str
+    tree: ast.Module
+    directives: Directives
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    module_locks: Dict[str, str] = field(default_factory=dict)  # name -> kind
+
+
+def _factory_kind(call: ast.AST) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    seg = last_segment(call.func)
+    if seg in LOCK_FACTORIES:
+        return "lock"
+    if seg in RLOCK_FACTORIES:
+        return "rlock"
+    if seg in COND_FACTORIES:
+        return "cond"
+    return None
+
+
+def build_module_model(path: str, relpath: str, source: str,
+                       tree: ast.Module) -> ModuleModel:
+    modname = os.path.splitext(os.path.basename(path))[0]
+    model = ModuleModel(path=path, relpath=relpath, modname=modname,
+                        tree=tree, directives=Directives(source))
+
+    # Module-level locks (e.g. rpc.transport._mock_lock).
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            kind = _factory_kind(stmt.value)
+            if kind:
+                model.module_locks[stmt.targets[0].id] = kind
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = ClassInfo(name=node.name, lineno=node.lineno,
+                         end_lineno=node.end_lineno or node.lineno)
+        for sub in ast.walk(node):
+            target = None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                target = sub.targets[0]
+                value = sub.value
+            elif isinstance(sub, ast.AnnAssign):
+                target = sub.target
+                value = sub.value
+            else:
+                continue
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            # Guard declaration: a `# guarded by:` comment anywhere in
+            # the assignment statement's line span.
+            for ln in range(sub.lineno, (sub.end_lineno or sub.lineno) + 1):
+                lock_expr = model.directives.guards.get(ln)
+                if lock_expr is not None:
+                    info.guards[target.attr] = lock_expr
+                    break
+            kind = _factory_kind(value)
+            if kind is None:
+                continue
+            info.lock_attrs[target.attr] = kind
+            if kind == "cond" and isinstance(value, ast.Call) \
+                    and value.args:
+                arg = value.args[0]
+                if isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "self":
+                    info.cond_aliases[target.attr] = arg.attr
+                else:
+                    info.cond_aliases[target.attr] = None
+            elif kind == "cond":
+                # Condition() with no argument owns a private RLock.
+                info.cond_aliases[target.attr] = None
+        model.classes[node.name] = info
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Held-lock walk.
+# ---------------------------------------------------------------------------
+
+
+class Hooks:
+    """Rule callbacks; override what you need."""
+
+    def on_acquire(self, ref: LockRef, held: List[LockRef],
+                   node: ast.AST) -> None:
+        pass
+
+    def on_attr(self, node: ast.Attribute, held: List[LockRef]) -> None:
+        pass
+
+    def on_call(self, node: ast.Call, held: List[LockRef]) -> None:
+        pass
+
+
+class HeldWalker:
+    """Walks one function/method tracking statically-held locks."""
+
+    def __init__(self, model: ModuleModel, cls: Optional[ClassInfo],
+                 func: ast.AST, hooks: Hooks):
+        self.model = model
+        self.cls = cls
+        self.func = func
+        self.hooks = hooks
+        self.held: List[LockRef] = []
+        self.local_locks: Dict[str, str] = {}   # name -> kind
+        self.local_conds: Dict[str, Optional[str]] = {}
+
+    # -- lock resolution ---------------------------------------------------
+
+    def resolve_lock(self, expr: ast.AST) -> Optional[LockRef]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            owner = expr.value.id
+            if owner == "self" and self.cls is not None:
+                return self.cls.lock_ref_for_attr(expr.attr)
+            # cls-style or foreign-object locks are not resolvable.
+            return None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            kind = self.local_locks.get(name)
+            if kind:
+                fname = getattr(self.func, "name", "<lambda>")
+                ref = LockRef(key=f"{self.model.modname}.{fname}.{name}",
+                              expr=name, kind=kind)
+                if kind == "cond":
+                    under = self.local_conds.get(name)
+                    if under and under in self.local_locks:
+                        ref.underlying = LockRef(
+                            key=f"{self.model.modname}.{fname}.{under}",
+                            expr=under, kind=self.local_locks[under])
+                return ref
+            kind = self.model.module_locks.get(name)
+            if kind:
+                return LockRef(key=f"{self.model.modname}.{name}",
+                               expr=name, kind=kind)
+        return None
+
+    # -- the walk ----------------------------------------------------------
+
+    def run(self) -> None:
+        name = getattr(self.func, "name", "")
+        if name.endswith("_locked") and self.cls is not None:
+            primary = self.cls.primary_lock_attr
+            if primary is not None:
+                ref = self.cls.lock_ref_for_attr(primary)
+                if ref is not None:
+                    self.held.append(ref)
+        for stmt in self.func.body:
+            self._walk(stmt)
+
+    def _push(self, ref: LockRef) -> List[LockRef]:
+        added = [ref]
+        self.held.append(ref)
+        if ref.underlying is not None:
+            self.held.append(ref.underlying)
+            added.append(ref.underlying)
+        return added
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            added: List[LockRef] = []
+            for item in node.items:
+                self._walk(item.context_expr)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars)
+                ref = self.resolve_lock(item.context_expr)
+                if ref is not None:
+                    self.hooks.on_acquire(ref, list(self.held), node)
+                    added.extend(self._push(ref))
+            for stmt in node.body:
+                self._walk(stmt)
+            for _ in added:
+                self.held.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: helper closures inherit the definition-site
+            # held set (see module docstring for the limitation).
+            for deco in node.decorator_list:
+                self._walk(deco)
+            for stmt in node.body:
+                self._walk(stmt)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body)
+            return
+        if isinstance(node, ast.ClassDef):
+            return  # nested classes: out of scope
+        if isinstance(node, ast.Assign):
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _factory_kind(node.value)
+                if kind:
+                    name = node.targets[0].id
+                    self.local_locks[name] = kind
+                    if kind == "cond" and isinstance(node.value, ast.Call) \
+                            and node.value.args and \
+                            isinstance(node.value.args[0], ast.Name):
+                        self.local_conds[name] = node.value.args[0].id
+        if isinstance(node, ast.Call):
+            self.hooks.on_call(node, list(self.held))
+        if isinstance(node, ast.Attribute):
+            self.hooks.on_attr(node, list(self.held))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+
+def iter_functions(model: ModuleModel):
+    """Yield (classinfo_or_None, function_node) for every def in the
+    module, outermost first.  Nested defs are walked by HeldWalker
+    itself (they inherit held state), so only top-level defs and direct
+    class methods are yielded."""
+
+    def class_for(node: ast.ClassDef) -> Optional[ClassInfo]:
+        return model.classes.get(node.name)
+
+    for stmt in model.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, stmt
+        elif isinstance(stmt, ast.ClassDef):
+            info = class_for(stmt)
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield info, sub
+
+
+# ---------------------------------------------------------------------------
+# Driver.
+# ---------------------------------------------------------------------------
+
+
+def _collect_py_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """(display_relpath, path) pairs.  The display path keeps the
+    input directory's own name as its first segment, so scope checks
+    (`scheduler/...`, `ops/...`) see the directory structure no matter
+    where the tree lives."""
+    out: List[Tuple[str, str]] = []
+    seen: Set[str] = set()
+
+    def add(rel: str, path: str) -> None:
+        ap = os.path.abspath(path)
+        if ap not in seen:
+            seen.add(ap)
+            out.append((rel.replace(os.sep, "/"), path))
+
+    for p in paths:
+        if os.path.isfile(p):
+            add(os.path.normpath(p), p)
+            continue
+        base = os.path.basename(os.path.normpath(p))
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    full = os.path.join(dirpath, f)
+                    add(os.path.join(base, os.path.relpath(full, p)),
+                        full)
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  config: Optional[AnalyzerConfig] = None
+                  ) -> Tuple[List[Finding], dict]:
+    """Run every rule family over the given files/directories.
+
+    Returns (findings, stats).  Findings matched by a
+    ``# ytpu: allow(<rule>)  # reason`` comment on their line come back
+    with ``suppressed=True``; a suppression without a reason adds a
+    ``suppression`` finding of its own.  The process exit decision
+    belongs to the caller (__main__): unsuppressed findings fail.
+    """
+    from . import jit_hygiene, lockrules
+
+    config = config or AnalyzerConfig()
+    files = _collect_py_files(paths)
+    findings: List[Finding] = []
+    analyzed = 0
+    for rel, path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as fp:
+                source = fp.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("parse-error", rel, 1, str(e)))
+            continue
+        analyzed += 1
+        model = build_module_model(path, rel, source, tree)
+        raw: List[Finding] = []
+        raw.extend(lockrules.check_module(model, config))
+        raw.extend(jit_hygiene.check_module(model, config))
+        # Suppression pass.
+        for f in raw:
+            s = model.directives.suppression_for(f.line, f.rule)
+            if s is not None:
+                s.used = True
+                f.suppressed = True
+            findings.append(f)
+        for s in model.directives.suppressions.values():
+            unknown = s.rules - set(RULES) - {"*"}
+            if unknown:
+                findings.append(Finding(
+                    "suppression", rel, s.line,
+                    f"unknown rule id(s) in suppression: "
+                    f"{', '.join(sorted(unknown))}"))
+            if not s.reason:
+                findings.append(Finding(
+                    "suppression", rel, s.line,
+                    "suppression without a written reason "
+                    "(# ytpu: allow(<rule>)  # why it is safe)"))
+            elif config.strict_suppressions and not s.used:
+                findings.append(Finding(
+                    "suppression", rel, s.line,
+                    "suppression matched no finding"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stats = {
+        "files_analyzed": analyzed,
+        "findings": sum(1 for f in findings if not f.suppressed),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+    }
+    return findings, stats

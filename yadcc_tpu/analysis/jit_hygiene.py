@@ -1,0 +1,240 @@
+"""Rule family 4: jit hygiene inside @jax.jit functions (ops/, parallel/).
+
+Three checks:
+
+* ``jit-nondet`` — wall-clock / RNG / uuid calls inside a jitted body.
+  They execute once at trace time and bake a constant into the
+  compiled executable; every later call silently reuses it.
+* ``jit-tracer-if`` — a Python ``if``/``while``/ternary whose test
+  mentions a *traced* parameter.  Under jit the test runs on a tracer
+  and raises TracerBoolConversionError at runtime — or worse, on a
+  weakly-typed value it silently specializes.  Shape/dtype probes
+  (``x.shape``, ``x.ndim``, ``len(x)``, ``isinstance``, ``x is None``)
+  are static and exempt.
+* ``jit-static-unhashable`` — a list/dict/set bound to a
+  ``static_argnames`` parameter (default value or module-local call
+  site).  Static args key the compilation cache and must be hashable.
+
+Detection is conservative (direct parameter mentions only; closures
+and derived locals are not tracked) — false negatives over false
+positives, like the lock rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    AnalyzerConfig,
+    Finding,
+    ModuleModel,
+    _dotted,
+    last_segment,
+    root_segment,
+)
+from .lockrules import _in_scope
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type",
+                 "sharding", "itemsize", "nbytes"}
+_STATIC_CALLS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
+                 "callable", "type"}
+
+_NONDET_ROOTS = {"random", "secrets", "uuid"}
+_NONDET_DOTTED = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "os.urandom",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_NONDET_PREFIXES = ("np.random.", "numpy.random.")
+
+_UNHASHABLE_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` as a bare expression."""
+    seg = last_segment(node)
+    if seg != "jit":
+        return False
+    root = root_segment(node)
+    return root in ("jax", "jit")
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        names.add(el.value)
+    return names
+
+
+def _static_nums_from_call(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int):
+                        nums.add(el.value)
+    return nums
+
+
+def _jit_spec_from_decorator(deco: ast.AST
+                             ) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when `deco` marks a jit."""
+    if _is_jit_expr(deco):
+        return set(), set()
+    if isinstance(deco, ast.Call):
+        # @jax.jit(...) directly.
+        if _is_jit_expr(deco.func):
+            return _static_names_from_call(deco), _static_nums_from_call(deco)
+        # @functools.partial(jax.jit, static_argnames=...).
+        if last_segment(deco.func) == "partial" and deco.args and \
+                _is_jit_expr(deco.args[0]):
+            return _static_names_from_call(deco), _static_nums_from_call(deco)
+    return None
+
+
+def _collect_jitted(tree: ast.Module
+                    ) -> List[Tuple[ast.FunctionDef, Set[str]]]:
+    """All jitted defs with their static parameter-name sets."""
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    out: List[Tuple[ast.FunctionDef, Set[str]]] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.FunctionDef, names: Set[str], nums: Set[int]) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+        static = set(names)
+        for i in nums:
+            if 0 <= i < len(params):
+                static.add(params[i])
+        out.append((fn, static))
+
+    for fn_list in defs_by_name.values():
+        for fn in fn_list:
+            for deco in fn.decorator_list:
+                spec = _jit_spec_from_decorator(deco)
+                if spec is not None:
+                    add(fn, *spec)
+                    break
+    # `g = jax.jit(fn, ...)` / `return jax.jit(fn)` over a local def.
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func) and \
+                node.args and isinstance(node.args[0], ast.Name):
+            for fn in defs_by_name.get(node.args[0].id, []):
+                add(fn, _static_names_from_call(node),
+                    _static_nums_from_call(node))
+    return out
+
+
+def _mentions_traced(node: ast.AST, traced: Set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call) and \
+            last_segment(node.func) in _STATIC_CALLS:
+        return False
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    return any(_mentions_traced(c, traced)
+               for c in ast.iter_child_nodes(node))
+
+
+def _check_body(model: ModuleModel, fn: ast.FunctionDef,
+                static: Set[str], findings: List[Finding]) -> None:
+    params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                              + fn.args.kwonlyargs)}
+    traced = params - static - {"self"}
+
+    # Unhashable defaults on static params.
+    pos = fn.args.posonlyargs + fn.args.args
+    for arg, default in zip(pos[len(pos) - len(fn.args.defaults):],
+                            fn.args.defaults):
+        if arg.arg in static and isinstance(default, _UNHASHABLE_NODES):
+            findings.append(Finding(
+                "jit-static-unhashable", model.relpath, default.lineno,
+                f"static arg '{arg.arg}' of {fn.name} defaults to an "
+                f"unhashable literal (jit cache keys must hash)"))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue  # nested defs get their own entry if jitted
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ""
+            root = root_segment(node.func)
+            if (dotted in _NONDET_DOTTED
+                    or root in _NONDET_ROOTS
+                    or any(dotted.startswith(p)
+                           for p in _NONDET_PREFIXES)):
+                findings.append(Finding(
+                    "jit-nondet", model.relpath, node.lineno,
+                    f"{dotted or root} inside @jit {fn.name}: traced "
+                    f"once, the value is baked into the executable"))
+        test = None
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+        elif isinstance(node, ast.IfExp):
+            test = node.test
+        if test is not None and _mentions_traced(test, traced):
+            findings.append(Finding(
+                "jit-tracer-if", model.relpath, test.lineno,
+                f"Python branch on traced argument inside @jit "
+                f"{fn.name}: use jnp.where/lax.cond or mark the arg "
+                f"static"))
+
+
+def _check_call_sites(model: ModuleModel,
+                      jitted: List[Tuple[ast.FunctionDef, Set[str]]],
+                      findings: List[Finding]) -> None:
+    by_name = {fn.name: static for fn, static in jitted if static}
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        static = by_name.get(name or "")
+        if not static:
+            continue
+        for kw in node.keywords:
+            if kw.arg in static and isinstance(kw.value, _UNHASHABLE_NODES):
+                findings.append(Finding(
+                    "jit-static-unhashable", model.relpath,
+                    kw.value.lineno,
+                    f"unhashable literal passed for static arg "
+                    f"'{kw.arg}' of {name}"))
+
+
+def check_module(model: ModuleModel,
+                 config: AnalyzerConfig) -> List[Finding]:
+    if not _in_scope(model.relpath, config.jit_path_fragments):
+        return []
+    findings: List[Finding] = []
+    jitted = _collect_jitted(model.tree)
+    for fn, static in jitted:
+        _check_body(model, fn, static, findings)
+    _check_call_sites(model, jitted, findings)
+    return findings

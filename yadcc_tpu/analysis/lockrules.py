@@ -1,0 +1,279 @@
+"""Rule families 1-3: guarded-by, lock-order, block-under-lock.
+
+All three share one held-lock walk per function (core.HeldWalker); each
+family is a Hooks callback recording findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    CONSTRUCTION_METHODS,
+    AnalyzerConfig,
+    ClassInfo,
+    Finding,
+    HeldWalker,
+    Hooks,
+    LockRef,
+    ModuleModel,
+    _dotted,
+    iter_functions,
+    last_segment,
+    root_segment,
+)
+
+# ---------------------------------------------------------------------------
+# block-under-lock matchers.
+# ---------------------------------------------------------------------------
+
+# Matched on the call's last dotted segment.
+_BLOCKING_LAST_SEG: Dict[str, str] = {
+    "sleep": "sleep",
+    "open": "file I/O",
+    "urlopen": "network I/O",
+    "communicate": "subprocess wait",
+    "accept": "socket I/O",
+    "recv": "socket I/O",
+    "recvfrom": "socket I/O",
+    "sendall": "socket I/O",
+    "connect": "socket I/O",
+    "select": "blocking select",
+    "call": "RPC call",
+    "block_until_ready": "device sync",
+    "device_get": "device transfer",
+    "device_put": "device transfer",
+    # /proc samplers (daemon/sysinfo.py) and their injection points:
+    # their contract is file I/O however cheap it looks at the call site.
+    "_memory_reader": "/proc sampling I/O",
+    "read_memory_available": "/proc sampling I/O",
+    "read_memory_total": "/proc sampling I/O",
+    "read_cgroup_present": "/proc sampling I/O",
+    "_read_proc_stat": "/proc sampling I/O",
+}
+
+# Matched on the call's root segment (module-style prefixes).
+_BLOCKING_ROOT: Dict[str, str] = {
+    "jnp": "device dispatch",
+    "jax": "device dispatch",
+    "subprocess": "subprocess",
+    "socket": "socket I/O",
+    "requests": "network I/O",
+    "urllib": "network I/O",
+}
+
+
+def _in_scope(relpath: str, fragments: Tuple[str, ...]) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return any(frag in parts for frag in fragments)
+
+
+class _GuardedByHooks(Hooks):
+    def __init__(self, model: ModuleModel, cls: Optional[ClassInfo],
+                 func: ast.AST, findings: List[Finding]):
+        self.model = model
+        self.cls = cls
+        self.func = func
+        self.findings = findings
+        name = getattr(func, "name", "")
+        self.exempt = name in CONSTRUCTION_METHODS
+
+    def _holds(self, held: List[LockRef], lock_expr: str) -> bool:
+        return any(h.expr == lock_expr for h in held)
+
+    def on_attr(self, node: ast.Attribute, held: List[LockRef]) -> None:
+        if self.exempt or self.cls is None:
+            return
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        required = self.cls.guards.get(node.attr)
+        if required is None:
+            return
+        if self._holds(held, required):
+            return
+        self.findings.append(Finding(
+            "guarded-by", self.model.relpath, node.lineno,
+            f"self.{node.attr} is declared guarded by {required} but "
+            f"accessed in {self.cls.name}."
+            f"{getattr(self.func, 'name', '?')} without it held"))
+
+    def on_call(self, node: ast.Call, held: List[LockRef]) -> None:
+        if self.exempt or self.cls is None:
+            return
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr.endswith("_locked")):
+            return
+        primary = self.cls.primary_lock_attr
+        if primary is None:
+            return
+        if self._holds(held, f"self.{primary}"):
+            return
+        self.findings.append(Finding(
+            "locked-call", self.model.relpath, node.lineno,
+            f"self.{func.attr}() requires self.{primary} held "
+            f"(callers must hold the lock the *_locked suffix declares)"))
+
+
+class _LockOrderHooks(Hooks):
+    def __init__(self, model: ModuleModel,
+                 findings: List[Finding],
+                 edges: List[Tuple[str, str, str, int]]):
+        self.model = model
+        self.findings = findings
+        self.edges = edges
+
+    @staticmethod
+    def _order_key(ref: LockRef) -> Optional[str]:
+        # Acquiring a Condition acquires its underlying lock; ordering
+        # is defined on real locks.  A Condition over an unknown lock
+        # contributes no edge.
+        if ref.kind == "cond":
+            return ref.underlying.key if ref.underlying else None
+        return ref.key
+
+    def on_acquire(self, ref: LockRef, held: List[LockRef],
+                   node: ast.AST) -> None:
+        new_key = self._order_key(ref)
+        if new_key is None:
+            return
+        held_keys = []
+        for h in held:
+            k = self._order_key(h)
+            if k is not None and k not in held_keys:
+                held_keys.append(k)
+        if new_key in held_keys and ref.kind == "lock":
+            self.findings.append(Finding(
+                "lock-order", self.model.relpath, node.lineno,
+                f"{ref.expr} is a non-reentrant Lock already held here "
+                f"(self-deadlock)"))
+            return
+        site = f"{self.model.relpath}:{node.lineno}"
+        for prev in held_keys:
+            if prev != new_key:
+                self.edges.append((prev, new_key, site, node.lineno))
+
+
+class _BlockUnderLockHooks(Hooks):
+    def __init__(self, model: ModuleModel, cls: Optional[ClassInfo],
+                 findings: List[Finding]):
+        self.model = model
+        self.cls = cls
+        self.findings = findings
+
+    def _wait_exempt(self, recv: ast.AST, held: List[LockRef]) -> bool:
+        """cv.wait() releases the lock while parked: waiting on a
+        Condition (or on the held lock object itself) is the one legal
+        blocking call under a lock."""
+        recv_str = _dotted(recv)
+        if recv_str is None:
+            return False
+        for h in held:
+            if h.expr == recv_str:
+                return True
+            if h.underlying is not None and h.underlying.expr == recv_str:
+                return True
+        if self.cls is not None and isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" \
+                and self.cls.lock_attrs.get(recv.attr) == "cond":
+            under = self.cls.cond_aliases.get(recv.attr)
+            if under is not None and any(
+                    h.expr == f"self.{under}" for h in held):
+                return True
+        return False
+
+    def on_call(self, node: ast.Call, held: List[LockRef]) -> None:
+        if not held:
+            return
+        func = node.func
+        seg = last_segment(func)
+        root = root_segment(func)
+        held_desc = ", ".join(sorted({h.expr for h in held}))
+        if seg == "wait":
+            if isinstance(func, ast.Attribute) and \
+                    self._wait_exempt(func.value, held):
+                return
+            self.findings.append(Finding(
+                "block-under-lock", self.model.relpath, node.lineno,
+                f"blocking wait under lock ({held_desc}): only a "
+                f"Condition over the held lock may wait here"))
+            return
+        if seg == "join" and isinstance(func, ast.Attribute):
+            recv = _dotted(func.value) or ""
+            if "thread" in recv.lower() or "proc" in recv.lower():
+                self.findings.append(Finding(
+                    "block-under-lock", self.model.relpath, node.lineno,
+                    f"thread join under lock ({held_desc})"))
+            return
+        what = None
+        if seg in _BLOCKING_LAST_SEG:
+            what = _BLOCKING_LAST_SEG[seg]
+        elif root in _BLOCKING_ROOT and root != seg:
+            what = _BLOCKING_ROOT[root]
+        if what is None:
+            return
+        self.findings.append(Finding(
+            "block-under-lock", self.model.relpath, node.lineno,
+            f"{what} ({_dotted(func) or seg}) inside a lock body "
+            f"({held_desc}) on a hot path"))
+
+
+def _check_edges(model: ModuleModel, config: AnalyzerConfig,
+                 edges: List[Tuple[str, str, str, int]],
+                 findings: List[Finding]) -> None:
+    ranks = config.lock_ranks
+    seen: Set[Tuple[str, str, int]] = set()
+    for prev, new, site, lineno in edges:
+        key = (prev, new, lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        rp, rn = ranks.get(prev), ranks.get(new)
+        if rp is None or rn is None:
+            missing = [n for n, r in ((prev, rp), (new, rn)) if r is None]
+            findings.append(Finding(
+                "lock-order", model.relpath, lineno,
+                f"nested acquisition {prev} -> {new} involves lock(s) "
+                f"not in lock_hierarchy.toml: {', '.join(missing)} "
+                f"(declare a rank or restructure)"))
+        elif rp >= rn:
+            findings.append(Finding(
+                "lock-order", model.relpath, lineno,
+                f"nested acquisition {prev} (rank {rp}) -> {new} "
+                f"(rank {rn}) inverts the declared hierarchy"))
+
+
+def check_module(model: ModuleModel,
+                 config: AnalyzerConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    edges: List[Tuple[str, str, str, int]] = []
+    hot = _in_scope(model.relpath, config.hot_path_fragments)
+    for cls, func in iter_functions(model):
+        hook_list: List[Hooks] = [
+            _GuardedByHooks(model, cls, func, findings),
+            _LockOrderHooks(model, findings, edges),
+        ]
+        if hot:
+            hook_list.append(_BlockUnderLockHooks(model, cls, findings))
+
+        class _Fan(Hooks):
+            def on_acquire(self, ref, held, node):
+                for h in hook_list:
+                    h.on_acquire(ref, held, node)
+
+            def on_attr(self, node, held):
+                for h in hook_list:
+                    h.on_attr(node, held)
+
+            def on_call(self, node, held):
+                for h in hook_list:
+                    h.on_call(node, held)
+
+        HeldWalker(model, cls, func, _Fan()).run()
+    _check_edges(model, config, edges, findings)
+    return findings

@@ -40,9 +40,10 @@ class BloomFilterGenerator:
         self._num_bits = num_bits
         self._num_hashes = num_hashes
         self._lock = threading.Lock()
-        self._filter = bloom.SaltedBloomFilter(num_bits, num_hashes,
-                                               self._salt)
-        self._new_keys: Deque[Tuple[float, str]] = deque()
+        self._filter = bloom.SaltedBloomFilter(
+            num_bits, num_hashes, self._salt)  # guarded by: self._lock
+        self._new_keys: Deque[Tuple[float, str]] = \
+            deque()  # guarded by: self._lock
         # Incremental sync can only cover windows this instance actually
         # observed; after a restart, older sync points need a full fetch
         # or clients would silently miss pre-restart keys.

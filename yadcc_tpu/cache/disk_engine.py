@@ -30,7 +30,10 @@ class DiskCacheEngine(CacheEngine):
         self._cache = DiskCache(shards, on_misplaced=on_misplaced)
         self._lock = threading.Lock()
         self._manifest_path = Path(shards[0].path) / "keys.manifest"
-        self._keys: Dict[str, str] = {}  # digest -> key
+        # digest -> key.  Manifest appends ride the same critical
+        # section (cache/ is not a latency-budgeted hot path; atomicity
+        # of memo + sidecar beats shaving the write).
+        self._keys: Dict[str, str] = {}  # guarded by: self._lock
         self._load_manifest()
 
     # -- SPI -----------------------------------------------------------------
@@ -72,23 +75,27 @@ class DiskCacheEngine(CacheEngine):
     # -- manifest --------------------------------------------------------------
 
     def _load_manifest(self) -> None:
+        # Construction-time only (no concurrent callers yet), but the
+        # guarded fields keep their discipline: taking the uncontended
+        # lock once beats carving out a lint exception.
         live = set(self._cache.digests())
-        if self._manifest_path.exists():
-            for line in self._manifest_path.read_text().splitlines():
-                digest, _, key = line.partition(" ")
-                if digest in live and key:
-                    self._keys[digest] = key
-        dropped = len(live) - len(self._keys)
-        if dropped > 0:
-            # Entries on disk with no manifest line (manifest lost or
-            # partially written): they stay servable by key but can't
-            # feed Bloom rebuild.
-            logger.warning("%d cache entries missing from key manifest",
-                           dropped)
-        # Compact: drop manifest lines for purged entries.
-        with open(self._manifest_path, "w") as fp:
-            for digest, key in self._keys.items():
-                fp.write(f"{digest} {key}\n")
+        with self._lock:
+            if self._manifest_path.exists():
+                for line in self._manifest_path.read_text().splitlines():
+                    digest, _, key = line.partition(" ")
+                    if digest in live and key:
+                        self._keys[digest] = key
+            dropped = len(live) - len(self._keys)
+            if dropped > 0:
+                # Entries on disk with no manifest line (manifest lost
+                # or partially written): they stay servable by key but
+                # can't feed Bloom rebuild.
+                logger.warning("%d cache entries missing from key "
+                               "manifest", dropped)
+            # Compact: drop manifest lines for purged entries.
+            with open(self._manifest_path, "w") as fp:
+                for digest, key in self._keys.items():
+                    fp.write(f"{digest} {key}\n")
 
 
 def _make_disk(dirs: str = "", capacity: int = 32 << 30,

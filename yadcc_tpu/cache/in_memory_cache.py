@@ -22,26 +22,27 @@ from ..utils.clock import REAL_CLOCK, Clock
 class InMemoryCache:
     def __init__(self, capacity_bytes: int, *, clock: Clock = REAL_CLOCK):
         self._c = capacity_bytes
-        self._p = 0  # adaptive target for T1 bytes
+        # Adaptive target for T1 bytes.
+        self._p = 0  # guarded by: self._lock
         self._clock = clock
         # key -> last get/put time; lets the purge timer expire entries
         # by idleness instead of waiting for capacity pressure
         # (reference runs cache purge on a 1-min timer,
         # cache_service_impl.cc:172-180).
-        self._touched: Dict[str, float] = {}
+        self._touched: Dict[str, float] = {}  # guarded by: self._lock
         self._lock = threading.Lock()
         # key -> value bytes; OrderedDict: LRU at the front.
-        self._t1: "OrderedDict[str, bytes]" = OrderedDict()
-        self._t2: "OrderedDict[str, bytes]" = OrderedDict()
+        self._t1: "OrderedDict[str, bytes]" = OrderedDict()  # guarded by: self._lock
+        self._t2: "OrderedDict[str, bytes]" = OrderedDict()  # guarded by: self._lock
         # Ghosts: key -> remembered size.
-        self._b1: "OrderedDict[str, int]" = OrderedDict()
-        self._b2: "OrderedDict[str, int]" = OrderedDict()
-        self._t1_bytes = 0
-        self._t2_bytes = 0
-        self._b1_bytes = 0
-        self._b2_bytes = 0
-        self.hits = 0
-        self.misses = 0
+        self._b1: "OrderedDict[str, int]" = OrderedDict()  # guarded by: self._lock
+        self._b2: "OrderedDict[str, int]" = OrderedDict()  # guarded by: self._lock
+        self._t1_bytes = 0  # guarded by: self._lock
+        self._t2_bytes = 0  # guarded by: self._lock
+        self._b1_bytes = 0  # guarded by: self._lock
+        self._b2_bytes = 0  # guarded by: self._lock
+        self.hits = 0  # guarded by: self._lock
+        self.misses = 0  # guarded by: self._lock
 
     # -- public ------------------------------------------------------------
 
@@ -99,7 +100,7 @@ class InMemoryCache:
                 if old is not None:
                     self._t2_bytes -= len(old)
             if old is not None:
-                self._make_room(size, ghost_hit_b2=False)
+                self._make_room_locked(size, ghost_hit_b2=False)
                 self._t2[key] = value
                 self._t2_bytes += size
                 return
@@ -113,7 +114,7 @@ class InMemoryCache:
                     self._p + max(gsize, self._b2_bytes // max(len(self._b2), 1)
                                   if self._b2 else gsize),
                 )
-                self._make_room(size, ghost_hit_b2=False)
+                self._make_room_locked(size, ghost_hit_b2=False)
                 self._t2[key] = value
                 self._t2_bytes += size
                 return
@@ -126,7 +127,7 @@ class InMemoryCache:
                     self._p - max(gsize, self._b1_bytes // max(len(self._b1), 1)
                                   if self._b1 else gsize),
                 )
-                self._make_room(size, ghost_hit_b2=True)
+                self._make_room_locked(size, ghost_hit_b2=True)
                 self._t2[key] = value
                 self._t2_bytes += size
                 return
@@ -135,7 +136,7 @@ class InMemoryCache:
             while self._t1_bytes + self._b1_bytes + size > self._c and self._b1:
                 k, s = self._b1.popitem(last=False)
                 self._b1_bytes -= s
-            self._make_room(size, ghost_hit_b2=False)
+            self._make_room_locked(size, ghost_hit_b2=False)
             self._t1[key] = value
             self._t1_bytes += size
             # Total directory (T+B) bounded by 2c.
@@ -187,9 +188,11 @@ class InMemoryCache:
 
     # -- internals -----------------------------------------------------------
 
-    def _make_room(self, incoming: int, ghost_hit_b2: bool) -> None:
+    def _make_room_locked(self, incoming: int, ghost_hit_b2: bool) -> None:
         """ARC REPLACE: evict from T1 or T2 (into its ghost list) until the
-        incoming entry fits."""
+        incoming entry fits.  (`_locked` suffix: callers hold
+        self._lock — renamed when ytpu-analyze's guarded-by pass
+        started enforcing the convention.)"""
         while self._t1_bytes + self._t2_bytes + incoming > self._c:
             from_t1 = bool(self._t1) and (
                 self._t1_bytes > self._p

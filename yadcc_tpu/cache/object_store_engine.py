@@ -99,9 +99,10 @@ class ObjectStoreEngine(CacheEngine):
         self._capacity = capacity_bytes
         self._resync_interval = resync_interval_s
         self._lock = threading.Lock()
-        self._sizes: Dict[str, int] = {}  # object name -> size
-        self._touched: Dict[str, float] = {}
-        self._last_resync = 0.0
+        # object name -> size
+        self._sizes: Dict[str, int] = {}  # guarded by: self._lock
+        self._touched: Dict[str, float] = {}  # guarded by: self._lock
+        self._last_resync = 0.0  # guarded by: self._lock
         self._resync()
 
     def _resync(self) -> None:

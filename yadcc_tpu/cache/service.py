@@ -65,16 +65,17 @@ class CacheService:
         self.l1 = l1
         self.l2 = l2
         self._l1_ttl_s = l1_ttl_s
-        self._purged_total = 0
+        self._purged_total = 0  # guarded by: self._lock
         self.bloom = BloomFilterGenerator(clock=clock)
         self._user_tokens = user_tokens
         self._servant_tokens = servant_tokens
         self._clock = clock
-        self._l2_hits = 0
-        self._fills = 0
+        self._l2_hits = 0  # guarded by: self._lock
+        self._fills = 0  # guarded by: self._lock
         self._lock = threading.Lock()
         # client ip -> (last_fetch_time, last_full_fetch_time)
-        self._client_sync: dict[str, tuple[float, float]] = {}
+        self._client_sync: dict[str, tuple[float, float]] = \
+            {}  # guarded by: self._lock
         # Initial rebuild so restarts serve a filter that matches L2.
         self.rebuild_bloom_filter()
 
@@ -101,7 +102,11 @@ class CacheService:
         dropped = self.l1.purge(self._l1_ttl_s)
         self.l2.purge()
         if dropped:
-            self._purged_total += dropped
+            # Under the lock like every other counter: the purge timer
+            # is single-threaded today, but inspect() reads concurrently
+            # and nothing pins the timer to one thread forever.
+            with self._lock:
+                self._purged_total += dropped
             logger.info("purged %d idle L1 entries (ttl=%.0fs)",
                         dropped, self._l1_ttl_s)
 
@@ -225,11 +230,14 @@ class CacheService:
     # -- introspection -------------------------------------------------------
 
     def inspect(self) -> dict:
+        with self._lock:
+            l2_hits, fills, purged = (self._l2_hits, self._fills,
+                                      self._purged_total)
         return {
             "l1": self.l1.stats(),
             "l2": {"engine": self.l2.name, **self.l2.stats()},
-            "l2_hits": self._l2_hits,
-            "fills": self._fills,
-            "l1_purged": self._purged_total,
+            "l2_hits": l2_hits,
+            "fills": fills,
+            "l1_purged": purged,
             "bloom_fill_ratio": round(self.bloom.fill_ratio(), 6),
         }

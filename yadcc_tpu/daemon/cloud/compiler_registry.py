@@ -38,8 +38,9 @@ class CompilerRegistry:
         self._extra_dirs = list(extra_dirs)
         self._bundle_dirs = list(bundle_dirs)
         self._lock = threading.Lock()
-        self._by_digest: Dict[str, str] = {}
-        self._digest_memo: Dict[tuple, str] = {}  # (real, size, mtime)
+        self._by_digest: Dict[str, str] = {}  # guarded by: self._lock
+        # (real, size, mtime) -> digest
+        self._digest_memo: Dict[tuple, str] = {}  # guarded by: self._lock
         self.rescan()
 
     # -- queries -------------------------------------------------------------

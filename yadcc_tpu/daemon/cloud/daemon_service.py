@@ -73,8 +73,8 @@ class DaemonService:
         self._cgroup = cgroup_present
         self._lock = threading.Lock()
         # Tokens delegates may present, as rolled out by the scheduler.
-        self._acceptable_tokens: Set[str] = set()
-        self._results: Dict[int, _TaskResult] = {}
+        self._acceptable_tokens: Set[str] = set()  # guarded by: self._lock
+        self._results: Dict[int, _TaskResult] = {}  # guarded by: self._lock
         self._beat_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._sched_channel: Optional[Channel] = None

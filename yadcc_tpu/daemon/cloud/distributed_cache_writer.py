@@ -22,7 +22,7 @@ class DistributedCacheWriter:
         """token_provider: callable returning the current servant token."""
         self._uri = cache_server_uri
         self._token_provider = token_provider
-        self._channel: Optional[Channel] = None
+        self._channel: Optional[Channel] = None  # guarded by: self._lock
         self._lock = threading.Lock()
 
     @property

@@ -100,10 +100,10 @@ class ExecutionEngine:
         self._min_memory = min_memory_for_new_task
         self._memory_reader = memory_reader
         self._lock = threading.Lock()
-        self._tasks: Dict[int, _Task] = {}
-        self._next_task_id = 1
-        self.tasks_run_ever = 0
-        self._rejected = 0
+        self._tasks: Dict[int, _Task] = {}  # guarded by: self._lock
+        self._next_task_id = 1  # guarded by: self._lock
+        self.tasks_run_ever = 0  # guarded by: self._lock
+        self._rejected = 0  # guarded by: self._lock
 
     # -- submission ----------------------------------------------------------
 
@@ -119,13 +119,19 @@ class ExecutionEngine:
     ) -> Optional[int]:
         """Start a task now or refuse (admission control).  Returns the
         servant task id, or None when the node is saturated."""
+        # Sample memory BEFORE taking the lock: the reader's contract is
+        # /proc/meminfo I/O, and every RPC worker thread funnels through
+        # this admission check — a slow read under the lock would stall
+        # heartbeat reporting (running_tasks) and completions behind it.
+        # The check is advisory; a grant-sized TOCTOU window is fine.
+        memory_ok = self._memory_reader() >= self._min_memory
         with self._lock:
             running = sum(1 for t in self._tasks.values()
                           if t.completed_at is None)
             if running >= self._max_concurrency:
                 self._rejected += 1
                 return None
-            if self._memory_reader() < self._min_memory:
+            if not memory_ok:
                 self._rejected += 1
                 return None
             task = _Task(

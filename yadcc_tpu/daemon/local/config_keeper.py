@@ -25,10 +25,10 @@ class ConfigKeeper:
         self._token = token
         self._interval = refresh_interval_s
         self._lock = threading.Lock()
-        self._serving_daemon_token = ""
+        self._serving_daemon_token = ""  # guarded by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._channel: Optional[Channel] = None
+        self._channel: Optional[Channel] = None  # guarded by: self._lock
 
     def start(self) -> None:
         self.refresh_once()
@@ -47,9 +47,7 @@ class ConfigKeeper:
 
     def refresh_once(self) -> None:
         try:
-            if self._channel is None:
-                self._channel = Channel(self._uri)
-            resp, _ = self._channel.call(
+            resp, _ = self._chan().call(
                 "ytpu.SchedulerService", "GetConfig",
                 api.scheduler.GetConfigRequest(token=self._token),
                 api.scheduler.GetConfigResponse, timeout=5.0)
@@ -57,6 +55,15 @@ class ConfigKeeper:
                 self._serving_daemon_token = resp.serving_daemon_token
         except RpcError as e:
             logger.warning("GetConfig failed: %s", e)
+
+    def _chan(self) -> Channel:
+        # start() calls refresh_once from the constructor thread before
+        # the refresh loop exists, so channel creation must be locked
+        # like every other _channel access.
+        with self._lock:
+            if self._channel is None:
+                self._channel = Channel(self._uri)
+            return self._channel
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self._interval):

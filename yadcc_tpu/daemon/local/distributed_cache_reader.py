@@ -33,18 +33,23 @@ class DistributedCacheReader:
     def __init__(self, cache_server_uri: str, token: str):
         self._uri = cache_server_uri
         self._token = token
-        self._salt = 0  # learned from each full fetch (rides the payload)
         self._lock = threading.Lock()
-        self._filter: Optional[bloom.SaltedBloomFilter] = None
-        self._last_full_fetch = 0.0
-        self._last_fetch = 0.0
+        # Learned from each full fetch (rides the payload); paired with
+        # _filter — they must only ever be read together under the lock
+        # (a full fetch replaces both; a torn read probes the new words
+        # with the old salt and returns garbage membership).
+        self._salt = 0  # guarded by: self._lock
+        self._filter: Optional[bloom.SaltedBloomFilter] = \
+            None  # guarded by: self._lock
+        self._last_full_fetch = 0.0  # guarded by: self._lock
+        self._last_fetch = 0.0  # guarded by: self._lock
         self._full_interval = _FULL_FETCH_INTERVAL_S * random.uniform(0.9, 1.1)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._channel: Optional[Channel] = None
-        self.hits = 0
-        self.bloom_rejects = 0
-        self.misses = 0
+        self._channel: Optional[Channel] = None  # guarded by: self._lock
+        self.hits = 0  # guarded by: self._lock
+        self.bloom_rejects = 0  # guarded by: self._lock
+        self.misses = 0  # guarded by: self._lock
 
     @property
     def enabled(self) -> bool:
@@ -72,17 +77,20 @@ class DistributedCacheReader:
         with self._lock:
             flt = self._filter
         if flt is not None and not flt.may_contain(key):
-            self.bloom_rejects += 1
+            with self._lock:
+                self.bloom_rejects += 1
             return None
         try:
             _, value = self._chan().call(
                 "ytpu.CacheService", "TryGetEntry",
                 api.cache.TryGetEntryRequest(token=self._token, key=key),
                 api.cache.TryGetEntryResponse, timeout=5.0)
-            self.hits += 1
+            with self._lock:
+                self.hits += 1
             return value
         except RpcError:
-            self.misses += 1
+            with self._lock:
+                self.misses += 1
             return None
 
     def batch_may_contain(self, keys: List[str]):
@@ -94,8 +102,13 @@ class DistributedCacheReader:
         hashing, no [N, 2] fingerprint upload (ops/bloom_pipeline.py)."""
         import numpy as np
 
+        # Snapshot filter AND salt under one lock hold: a concurrent
+        # full fetch swaps both, and probing new words with the old
+        # salt (or vice versa) yields wrong membership answers — found
+        # by ytpu-analyze (guarded-by) when _salt gained its annotation.
         with self._lock:
             flt = self._filter
+            salt = self._salt
         if flt is None or not keys:
             return np.ones(len(keys), bool)
         import jax.numpy as jnp
@@ -103,7 +116,7 @@ class DistributedCacheReader:
         from ...ops.bloom_pipeline import bloom_membership_batch
 
         return bloom_membership_batch(
-            jnp.asarray(flt.words), keys, self._salt,
+            jnp.asarray(flt.words), keys, salt,
             num_bits=flt.num_bits, num_hashes=flt.num_hashes)
 
     # -- sync ----------------------------------------------------------------
@@ -157,6 +170,6 @@ class DistributedCacheReader:
 
     def inspect(self) -> dict:
         with self._lock:
-            synced = self._filter is not None
-        return {"synced": synced, "hits": self.hits,
-                "bloom_rejects": self.bloom_rejects, "misses": self.misses}
+            return {"synced": self._filter is not None, "hits": self.hits,
+                    "bloom_rejects": self.bloom_rejects,
+                    "misses": self.misses}

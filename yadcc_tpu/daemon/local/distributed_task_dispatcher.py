@@ -81,11 +81,11 @@ class DistributedTaskDispatcher:
         # every servant dial goes HERE; grants still flow normally.
         self._debug_servant = debugging_always_use_servant_at
         self._lock = threading.Lock()
-        self._tasks: Dict[int, _Entry] = {}
-        self._next_id = 1
-        self._channels: Dict[str, Channel] = {}
+        self._tasks: Dict[int, _Entry] = {}  # guarded by: self._lock
+        self._next_id = 1  # guarded by: self._lock
+        self._channels: Dict[str, Channel] = {}  # guarded by: self._lock
         self.stats = {"hit_cache": 0, "reused": 0, "actually_run": 0,
-                      "failed": 0}
+                      "failed": 0}  # guarded by: self._lock
 
     # -- public API ----------------------------------------------------------
 
@@ -141,7 +141,11 @@ class DistributedTaskDispatcher:
             result = TaskResult(
                 exit_code=-1,
                 standard_error=f"ytpu daemon error: {e!r}".encode())
-            self.stats["failed"] += 1
+            # Counter updates take the lock: one TU thread runs per
+            # in-flight task, and dict `+=` is a read-modify-write that
+            # loses increments when two of them interleave.
+            with self._lock:
+                self.stats["failed"] += 1
         with self._lock:
             entry.result = result
             entry.state = TaskState.DONE
@@ -167,7 +171,8 @@ class DistributedTaskDispatcher:
         if result is None:
             logger.warning("corrupted cache entry for %s", key)
             return None
-        self.stats["hit_cache"] += 1
+        with self._lock:
+            self.stats["hit_cache"] += 1
         return result
 
     def _try_join_existing(self, entry: _Entry) -> Optional[TaskResult]:
@@ -197,7 +202,8 @@ class DistributedTaskDispatcher:
         # never reaches zero and it leaks until servant GC.
         self._free_servant_task(entry, token)
         if result is not None:
-            self.stats["reused"] += 1
+            with self._lock:
+                self.stats["reused"] += 1
         return result
 
     def _start_new_servant_task(self, entry: _Entry) -> TaskResult:
@@ -229,7 +235,8 @@ class DistributedTaskDispatcher:
                 exit_code=-1,
                 standard_error=b"servant lost while compiling")
         else:
-            self.stats["actually_run"] += 1
+            with self._lock:
+                self.stats["actually_run"] += 1
         return result
 
     def _wait_servant(self, entry: _Entry,

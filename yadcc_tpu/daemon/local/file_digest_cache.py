@@ -28,7 +28,7 @@ class FileDigestCache:
         self._lock = threading.Lock()
         self._capacity = max(1, capacity)
         self._memo: "OrderedDict[Tuple[str, int, int], str]" = \
-            OrderedDict()
+            OrderedDict()  # guarded by: self._lock
 
     def set(self, path: str, size: int, mtime: int, digest: str) -> None:
         with self._lock:

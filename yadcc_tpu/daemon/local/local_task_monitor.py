@@ -47,8 +47,8 @@ class LocalTaskMonitor:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # pid -> counts per class.
-        self._light: Dict[int, int] = defaultdict(int)
-        self._heavy: Dict[int, int] = defaultdict(int)
+        self._light: Dict[int, int] = defaultdict(int)  # guarded by: self._lock
+        self._heavy: Dict[int, int] = defaultdict(int)  # guarded by: self._lock
 
     # -- acquisition ---------------------------------------------------------
 

@@ -30,10 +30,10 @@ class RunningTaskKeeper:
         self._uri = scheduler_uri
         self._interval = refresh_interval_s
         self._lock = threading.Lock()
-        self._by_digest: Dict[str, FoundTask] = {}
+        self._by_digest: Dict[str, FoundTask] = {}  # guarded by: self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._channel: Optional[Channel] = None
+        self._channel: Optional[Channel] = None  # guarded by: self._lock
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop,
@@ -52,9 +52,7 @@ class RunningTaskKeeper:
 
     def refresh_once(self) -> None:
         try:
-            if self._channel is None:
-                self._channel = Channel(self._uri)
-            resp, _ = self._channel.call(
+            resp, _ = self._chan().call(
                 "ytpu.SchedulerService", "GetRunningTasks",
                 api.scheduler.GetRunningTasksRequest(),
                 api.scheduler.GetRunningTasksResponse, timeout=5.0)
@@ -67,6 +65,12 @@ class RunningTaskKeeper:
                 self._by_digest = table
         except RpcError as e:
             logger.warning("GetRunningTasks failed: %s", e)
+
+    def _chan(self) -> Channel:
+        with self._lock:
+            if self._channel is None:
+                self._channel = Channel(self._uri)
+            return self._channel
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=self._interval):

@@ -48,7 +48,7 @@ class _EnvFetcher:
         self.keeper = keeper
         self.env_digest = env_digest
         self.queue: "queue.Queue[Grant]" = queue.Queue()
-        self.waiters = 0
+        self.waiters = 0  # guarded by: self.lock
         self.lock = threading.Lock()
         self.wake = threading.Event()
         self.retired = threading.Event()
@@ -145,9 +145,9 @@ class TaskGrantKeeper:
         self._token = token
         self._min_version = min_version
         self._lock = threading.Lock()
-        self._fetchers: Dict[str, _EnvFetcher] = {}
+        self._fetchers: Dict[str, _EnvFetcher] = {}  # guarded by: self._lock
         self._stopping = threading.Event()
-        self._channel: Optional[Channel] = None
+        self._channel: Optional[Channel] = None  # guarded by: self._lock
 
     def get(self, env_digest: str, timeout_s: float = 10.0) -> Optional[Grant]:
         now = time.monotonic()

@@ -78,7 +78,8 @@ class LoadAverageSampler:
 
     def __init__(self, nprocs: Optional[int] = None):
         self._nprocs = nprocs or os.cpu_count() or 1
-        self._samples: Deque[Tuple[float, float]] = deque(maxlen=_MAX_SAMPLES)
+        self._samples: Deque[Tuple[float, float]] = \
+            deque(maxlen=_MAX_SAMPLES)  # guarded by: self._lock
         self._lock = threading.Lock()
         self.sample()
 

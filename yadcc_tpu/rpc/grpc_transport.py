@@ -101,7 +101,8 @@ class GrpcChannel(Channel):
         target = uri[len("grpc://") :] if uri.startswith("grpc://") else uri
         self._channel = grpc.insecure_channel(target, options=_CHANNEL_OPTIONS)
         self._lock = threading.Lock()
-        self._callables: Dict[Tuple[str, str], grpc.UnaryUnaryMultiCallable] = {}
+        self._callables: Dict[Tuple[str, str], grpc.UnaryUnaryMultiCallable] \
+            = {}  # guarded by: self._lock
 
     def _callable(self, service: str, method_name: str):
         key = (service, method_name)

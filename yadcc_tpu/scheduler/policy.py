@@ -30,7 +30,7 @@ class EnvRegistry:
 
     def __init__(self, max_envs: int = 256):
         self.max_envs = max_envs
-        self._ids: Dict[str, int] = {}
+        self._ids: Dict[str, int] = {}  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def intern(self, digest: str) -> Optional[int]:

@@ -23,7 +23,8 @@ class RunningTaskRecord:
 class RunningTaskBookkeeper:
     def __init__(self):
         self._lock = threading.Lock()
-        self._by_servant: Dict[str, List[RunningTaskRecord]] = {}
+        self._by_servant: Dict[str, List[RunningTaskRecord]] = \
+            {}  # guarded by: self._lock
 
     def set_servant_running_tasks(
         self, location: str, tasks: Sequence[RunningTaskRecord]

@@ -41,8 +41,10 @@ class ServingDaemonTokenRoll:
         self._clock = clock
         self._rotation_s = rotation_s
         self._lock = threading.Lock()
-        self._tokens: List[str] = [generate_token() for _ in range(_TOKEN_WINDOW)]
-        self._last_rotation = clock.now()
+        self._tokens: List[str] = [
+            generate_token() for _ in range(_TOKEN_WINDOW)
+        ]  # guarded by: self._lock
+        self._last_rotation = clock.now()  # guarded by: self._lock
 
     def _maybe_rotate_locked(self) -> None:
         now = self._clock.now()

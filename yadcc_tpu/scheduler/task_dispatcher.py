@@ -166,12 +166,14 @@ class TaskDispatcher:
         # the first heartbeat).
         self._env_words = (max_envs + 31) // 32
 
-        self._slots: List[Optional[_Servant]] = [None] * max_servants
-        self._free_slots = list(range(max_servants - 1, -1, -1))
-        self._by_location: Dict[str, int] = {}
+        self._slots: List[Optional[_Servant]] = \
+            [None] * max_servants  # guarded by: self._lock
+        self._free_slots = list(
+            range(max_servants - 1, -1, -1))  # guarded by: self._lock
+        self._by_location: Dict[str, int] = {}  # guarded by: self._lock
         # ip -> slots on that machine: requestor self-avoidance lookups
         # happen per grant request and must not scan 5k locations.
-        self._by_ip: Dict[str, set] = {}
+        self._by_ip: Dict[str, set] = {}  # guarded by: self._lock
         # The struct-of-arrays pool view, maintained INCREMENTALLY —
         # the per-cycle snapshot is a handful of vectorized numpy ops,
         # not an O(S) Python rebuild (the host-side scan this design
@@ -181,31 +183,33 @@ class TaskDispatcher:
         # the running counter; effective capacity is derived vectorized
         # at snapshot time, so the grant hot path never recomputes it
         # per slot in Python.
-        self._arr_alive = np.zeros(max_servants, bool)
-        self._arr_cap_rep = np.zeros(max_servants, np.int32)
-        self._arr_nprocs = np.zeros(max_servants, np.int32)
-        self._arr_load = np.zeros(max_servants, np.int32)
-        self._arr_mem_ok = np.zeros(max_servants, bool)
-        self._arr_accepting = np.zeros(max_servants, bool)
-        self._arr_running = np.zeros(max_servants, np.int32)
-        self._arr_dedicated = np.zeros(max_servants, bool)
-        self._arr_version = np.zeros(max_servants, np.int32)
+        self._arr_alive = np.zeros(max_servants, bool)  # guarded by: self._lock
+        self._arr_cap_rep = np.zeros(max_servants, np.int32)  # guarded by: self._lock
+        self._arr_nprocs = np.zeros(max_servants, np.int32)  # guarded by: self._lock
+        self._arr_load = np.zeros(max_servants, np.int32)  # guarded by: self._lock
+        self._arr_mem_ok = np.zeros(max_servants, bool)  # guarded by: self._lock
+        self._arr_accepting = np.zeros(max_servants, bool)  # guarded by: self._lock
+        self._arr_running = np.zeros(max_servants, np.int32)  # guarded by: self._lock
+        self._arr_dedicated = np.zeros(max_servants, bool)  # guarded by: self._lock
+        self._arr_version = np.zeros(max_servants, np.int32)  # guarded by: self._lock
         self._arr_env = np.zeros((max_servants, self._env_words),
-                                 np.uint32)
-        self._pool_epoch = 0
+                                 np.uint32)  # guarded by: self._lock
+        self._pool_epoch = 0  # guarded by: self._lock
         # Slot occupancy generation: bumped when a slot changes hands.
         # The apply phase compares against its snapshot-time copy so a
         # slot recycled to a DIFFERENT machine while the policy ran
         # unlocked never receives a grant scored for the old occupant
         # (whose envs/version/identity the decision was based on).
-        self._slot_generation = np.zeros(max_servants, np.int64)
+        self._slot_generation = np.zeros(
+            max_servants, np.int64)  # guarded by: self._lock
 
-        self._grants: Dict[int, _Grant] = {}
-        self._next_grant_id = 1
+        self._grants: Dict[int, _Grant] = {}  # guarded by: self._lock
+        self._next_grant_id = 1  # guarded by: self._lock
 
-        self._pending: List[_Pending] = []
-        self._stopping = False
-        self._stats = {"granted": 0, "expired_grants": 0, "zombies_killed": 0}
+        self._pending: List[_Pending] = []  # guarded by: self._lock
+        self._stopping = False  # guarded by: self._lock
+        self._stats = {"granted": 0, "expired_grants": 0,
+                       "zombies_killed": 0}  # guarded by: self._lock
 
         # Per-stage grant-path latency (queue-wait -> snapshot -> policy
         # -> apply), timed with the injectable clock; surfaces in
@@ -221,12 +225,13 @@ class TaskDispatcher:
         # the main lock.  Joins, leaves, and registry-full detection
         # stay synchronous on the main lock.
         self._hb_lock = threading.Lock()
-        self._hb_staged: Dict[str, Tuple[ServantInfo, float]] = {}
+        self._hb_staged: Dict[str, Tuple[ServantInfo, float]] = \
+            {}  # guarded by: self._hb_lock
 
         # Prepared-snapshot buffers (see _snapshot_locked): dispatch
         # cycles read an incrementally-maintained snapshot instead of
         # copying six pool arrays under the lock every cycle.
-        self._snap_buffers: List[_SnapBuffer] = []
+        self._snap_buffers: List[_SnapBuffer] = []  # guarded by: self._lock
         # Sync mode releases each lease when the policy returns, so two
         # buffers suffice (one leased, one publishing); pipelined mode
         # holds a lease per in-flight launch until its drain.
@@ -245,11 +250,12 @@ class TaskDispatcher:
         self._pipelined = bool(
             pipeline_depth > 0
             and getattr(policy, "supports_stream", False))
-        self._pipe_active = False
-        self._pipe_adj = np.zeros(max_servants, np.int64)
-        self._pipe_resets: Dict[int, int] = {}
-        self._pipe_reset_barrier = np.full(max_servants, -1, np.int64)
-        self._pipe_launch_seq = 0
+        self._pipe_active = False  # guarded by: self._lock
+        self._pipe_adj = np.zeros(max_servants, np.int64)  # guarded by: self._lock
+        self._pipe_resets: Dict[int, int] = {}  # guarded by: self._lock
+        self._pipe_reset_barrier = np.full(
+            max_servants, -1, np.int64)  # guarded by: self._lock
+        self._pipe_launch_seq = 0  # guarded by: self._lock
 
         # Inline-leader dispatch: the first waiter of an idle backlog
         # runs the cycle on its own thread (two condvar handoffs and
@@ -260,7 +266,7 @@ class TaskDispatcher:
         # that no cycle runs unless they run one.
         self._inline_dispatch = bool(
             start_dispatch_thread and not self._pipelined)
-        self._inline_busy = False
+        self._inline_busy = False  # guarded by: self._lock
 
         self._thread: Optional[threading.Thread] = None
         if start_dispatch_thread:
@@ -300,7 +306,7 @@ class TaskDispatcher:
                 return True
         # Benign unlocked read: a concurrent drop just means the staged
         # beat re-joins at flush time (the servant IS alive — it beat).
-        if info.location in self._by_location:
+        if info.location in self._by_location:  # ytpu: allow(guarded-by)  # racy membership probe is the staging fast path's point; any outcome is repaired at flush (see comment above)
             expires_at = self._clock.now() + expires_in_s
             with self._hb_lock:
                 self._hb_staged[info.location] = (info, expires_at)

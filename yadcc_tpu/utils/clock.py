@@ -25,7 +25,7 @@ class VirtualClock(Clock):
     """Manually-advanced clock for tests."""
 
     def __init__(self, start: float = 0.0):
-        self._now = start
+        self._now = start  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def now(self) -> float:

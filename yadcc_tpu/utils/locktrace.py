@@ -65,15 +65,16 @@ class LockGraph:
         import collections
 
         self._g = _real_lock()  # guards the graph itself (never traced)
-        self._edges: Dict[str, Set[str]] = {}
-        self._preds: Dict[str, Set[str]] = {}  # reverse index: O(degree)
-        #                                        pruning of GC'd nodes
-        self._edge_sites: Dict[Tuple[str, str], str] = {}
-        self.violations: List[str] = []
-        self._reported: Set[Tuple[str, ...]] = set()
-        self._stacks: Dict[int, List[str]] = {}
-        self._n_edges = 0
-        self.saturated = False
+        self._edges: Dict[str, Set[str]] = {}  # guarded by: self._g
+        # Reverse index: O(degree) pruning of GC'd nodes.
+        self._preds: Dict[str, Set[str]] = {}  # guarded by: self._g
+        self._edge_sites: Dict[Tuple[str, str], str] = \
+            {}  # guarded by: self._g
+        self.violations: List[str] = []  # guarded by: self._g
+        self._reported: Set[Tuple[str, ...]] = set()  # guarded by: self._g
+        self._stacks: Dict[int, List[str]] = {}  # guarded by: self._g
+        self._n_edges = 0  # guarded by: self._g
+        self.saturated = False  # guarded by: self._g
         # GC'd proxies queue their names here (deque.append is atomic,
         # so __del__ — which can fire mid-note_acquired via GC — never
         # touches _g); pruning happens at the next traced event.
@@ -382,6 +383,21 @@ def installed():
         yield graph
     finally:
         uninstall()
+
+
+def framework_violations(graph: LockGraph,
+                         needle: str = "yadcc_tpu") -> List[str]:
+    """Violations involving at least one framework-constructed lock.
+
+    Lock names carry their construction module (`_name_from_site`), so
+    filtering on the package name separates OUR ordering bugs from
+    cycles purely among third-party locks (tracing a window in which
+    jax compiles will wrap jax's internal locks too — their internal
+    ordering is not this repo's CI gate).  Used by the tier-1 stress
+    fixtures (tests/test_stress.py, tests/test_pipelined_dispatch.py),
+    which run under tracing unconditionally and assert this is empty.
+    """
+    return [v for v in graph.violations if needle in v]
 
 
 def install_from_env() -> Optional[LockGraph]:

@@ -50,7 +50,7 @@ class StageTimer:
         self._lock = threading.Lock()
         self._stages: Dict[str, _Reservoir] = {
             s: _Reservoir(maxlen) for s in stages
-        }
+        }  # guarded by: self._lock
 
     def record(self, stage: str, seconds: float) -> None:
         with self._lock:
