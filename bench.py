@@ -378,8 +378,23 @@ def main() -> None:
     else:
         rtt_regime = "colocated"
 
+    # Host-side data-plane throughput (tools/dataplane_bench): the
+    # zero-copy copy-path composite at 1MB.  Cheap, host-only, and a
+    # regression canary for the byte path riding along with the
+    # device numbers.
+    try:
+        from yadcc_tpu.tools.dataplane_bench import \
+            quick_dataplane_mb_per_sec
+
+        dataplane_mb = round(quick_dataplane_mb_per_sec(), 1)
+    except Exception:
+        dataplane_mb = None
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 4 (r07+): adds `dataplane_mb_per_sec` (zero-copy
+        # copy-path composite at 1MB, tools/dataplane_bench stage
+        # definitions — see doc/benchmarks.md "Data plane").
         # Version 3 (r06+): adds `dispatcher_rtt_regime` (see above)
         # and runs the full-dispatcher sections against the
         # incremental prepared-snapshot dispatcher.  Version 2: the
@@ -388,7 +403,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 3,
+        "harness_version": 4,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -420,6 +435,7 @@ def main() -> None:
         "dispatcher_rtt_regime": rtt_regime,
         "heartbeats_per_sec": beats_per_sec,
         "bloom_fingerprint_mkeys_per_sec": bloom_fp,
+        "dataplane_mb_per_sec": dataplane_mb,
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

@@ -13,7 +13,9 @@ import numpy as np
 
 from yadcc_tpu.common import compress
 from yadcc_tpu.common.multi_chunk import (make_multi_chunk,
-                                          try_parse_multi_chunk)
+                                          make_multi_chunk_payload,
+                                          try_parse_multi_chunk,
+                                          try_parse_multi_chunk_views)
 from yadcc_tpu.daemon.cache_format import (CacheEntry, try_parse_cache_entry,
                                            write_cache_entry)
 
@@ -48,6 +50,78 @@ def test_multi_chunk_parser_never_raises():
     # And the happy path still round-trips after all that.
     assert try_parse_multi_chunk(base) == [b"json-part",
                                            b"\x00\x01payload" * 20]
+
+
+def test_multi_chunk_view_parser_never_raises_and_agrees():
+    """The zero-copy parser must accept/reject exactly the same byte
+    soups as the copying parser, with identical chunk contents."""
+    rng = np.random.default_rng(10)
+    base = make_multi_chunk([b"json-part", b"", b"\x00\x01payload" * 40])
+    for _ in range(ROUNDS):
+        mutated = _mutations(rng, base)
+        views = try_parse_multi_chunk_views(mutated)
+        copied = try_parse_multi_chunk(mutated)
+        if views is None:
+            assert copied is None
+        else:
+            assert copied is not None
+            assert [bytes(v) for v in views] == copied
+
+
+def test_multi_chunk_view_parser_edge_frames():
+    # Truncated length prefixes (header never terminates, or the body
+    # is cut mid-chunk).
+    assert try_parse_multi_chunk_views(b"12") is None
+    assert try_parse_multi_chunk_views(b"12,") is None
+    assert try_parse_multi_chunk_views(b"5\r\nxx") is None
+    # Lengths overrunning the buffer.
+    assert try_parse_multi_chunk_views(b"999\r\nshort") is None
+    assert try_parse_multi_chunk_views(b"4,5\r\nonlyfour") is None
+    # Negative / junk lengths.
+    assert try_parse_multi_chunk_views(b"-1\r\n") is None
+    assert try_parse_multi_chunk_views(b"a,2\r\nxx") is None
+    # Zero-length chunks (leading, middle, trailing) parse as empties.
+    frame = make_multi_chunk([b"", b"AB", b"", b"C", b""])
+    views = try_parse_multi_chunk_views(frame)
+    assert views == [b"", b"AB", b"", b"C", b""]
+    # Empty list round-trips.
+    assert try_parse_multi_chunk_views(b"\r\n") == []
+    assert try_parse_multi_chunk_views(b"") is None
+
+
+def test_multi_chunk_parse_rebuild_roundtrip_identity():
+    """parse→rebuild is byte-identical for canonical frames, for both
+    owned-bytes and view chunks, and from a memoryview input."""
+    rng = np.random.default_rng(11)
+    for _ in range(50):
+        n = int(rng.integers(0, 6))
+        chunks = [rng.bytes(int(rng.integers(0, 2000))) for _ in range(n)]
+        frame = make_multi_chunk(chunks)
+        views = try_parse_multi_chunk_views(frame)
+        assert make_multi_chunk_payload(views).join() == frame
+        views2 = try_parse_multi_chunk_views(memoryview(frame))
+        assert make_multi_chunk_payload(views2).join() == frame
+
+
+def test_fused_decompress_digest_corruption_parity():
+    """decompress_and_digest must fail (CompressionError, partial output
+    discarded) exactly when try_decompress reads corruption, and agree
+    byte-for-byte + digest-for-digest when both succeed."""
+    from yadcc_tpu.common.hashing import digest_bytes
+
+    rng = np.random.default_rng(12)
+    blob = compress.compress(b"void f();\n" * 2000)
+    for _ in range(ROUNDS):
+        mutated = _mutations(rng, blob)
+        legacy = compress.try_decompress(mutated)
+        try:
+            fused, digest = compress.decompress_and_digest(mutated)
+        except (compress.CompressionError, MemoryError, ValueError):
+            fused = None
+        if legacy is None:
+            assert fused is None
+        else:
+            assert fused == legacy and digest == digest_bytes(legacy)
 
 
 def test_cache_entry_parser_never_raises():

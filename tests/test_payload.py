@@ -1,0 +1,284 @@
+"""Zero-copy data plane: Payload semantics, wire parity, copy budget.
+
+Three tiers:
+
+* Payload/unit — segment bookkeeping, slicing, the copy meter.
+* Parity — the zero-copy codecs must be BYTE-IDENTICAL to the pre-PR
+  implementations (kept verbatim in tools/_dataplane_legacy) for every
+  frame and cache entry, and the modeled task round trip must need at
+  least 3 fewer copies per task (the regression guard behind the
+  dataplane_bench artifact).
+* Mixed cluster — a real loopback cluster with one side running the
+  legacy path and the other the zero-copy path round-trips compiles
+  and cache hits, proving wire/cache-format compatibility in vivo.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from yadcc_tpu.common import compress
+from yadcc_tpu.common.hashing import digest_bytes
+from yadcc_tpu.common.multi_chunk import (make_multi_chunk_payload,
+                                          try_parse_multi_chunk_views)
+from yadcc_tpu.common.payload import Payload, copy_counting
+from yadcc_tpu.daemon import packing
+from yadcc_tpu.daemon.cache_format import (CacheEntry, try_parse_cache_entry,
+                                           write_cache_entry,
+                                           write_cache_entry_payload)
+from yadcc_tpu.rpc import transport as tp
+from yadcc_tpu.tools import _dataplane_legacy as L
+
+
+class TestPayload:
+    def test_segments_len_join(self):
+        p = Payload.of(b"abc", memoryview(b"defgh"), b"", Payload.of(b"xy"))
+        assert len(p) == 10
+        assert p.num_segments == 3  # empties dropped, nested flattened
+        assert p.join() == b"abcdefghxy"
+        assert b"".join(bytes(s) for s in p.iter_segments()) == p.join()
+
+    def test_empty(self):
+        assert len(Payload()) == 0
+        assert Payload().join() == b""
+        assert not Payload()
+        assert Payload.of(b"x")
+
+    def test_slice_matches_joined_slice(self):
+        p = Payload.of(b"0123", b"456", b"789abc")
+        joined = p.join()
+        for start, stop in [(0, 13), (2, 11), (4, 4), (3, 8), (0, 0),
+                            (5, 200), (12, 13)]:
+            assert p.slice(start, stop).join() == joined[start:stop]
+
+    def test_exotic_views_normalized(self):
+        # Non-contiguous / non-byte views must still join cleanly.
+        p = Payload.of(memoryview(b"abcdef")[::-1], b"g")
+        assert p.join() == b"fedcbag"
+
+    def test_join_counts_one_copy_single_bytes_counts_none(self):
+        with copy_counting() as c:
+            Payload.of(b"a" * 10, b"b" * 5).join()
+        assert (c.copies, c.bytes) == (1, 15)
+        with copy_counting() as c:
+            Payload.from_bytes(b"already-contiguous").join()
+        assert c.copies == 0
+
+    def test_update_into_digests_without_join(self):
+        from yadcc_tpu.common.hashing import new_digest
+
+        p = Payload.of(b"seg1", b"seg2", b"seg3")
+        h = new_digest()
+        with copy_counting() as c:
+            p.update_into(h)
+        assert c.copies == 0
+        assert h.hexdigest() == digest_bytes(b"seg1seg2seg3")
+
+
+class TestWireParity:
+    """Every producer/consumer pair: new path vs preserved pre-PR path."""
+
+    def test_multi_chunk_byte_identity(self):
+        chunks = [b"{\"j\":1}", b"x" * 100_000, b"", b"tail"]
+        legacy = L.legacy_make_multi_chunk(chunks)
+        assert make_multi_chunk_payload(chunks).join() == legacy
+        views = try_parse_multi_chunk_views(legacy)
+        assert views == L.legacy_try_parse_multi_chunk(legacy)
+        # Parse -> rebuild -> identical frame, straight from views.
+        assert make_multi_chunk_payload(views).join() == legacy
+
+    def test_rpc_frame_byte_identity(self):
+        att = Payload.of(b"part1", b"part2" * 1000)
+        new = tp.encode_frame_payload(7, b"meta", att).join()
+        legacy = (b"".join((bytes(bytearray([7, 0, 0, 0, 4, 0, 0, 0])),
+                            b"meta", att.join())))
+        assert new == legacy
+        s, m, a = tp.decode_frame_views(new)
+        assert (s, m, a) == tp.decode_frame(new)
+
+    def test_keyed_buffers_byte_identity(self):
+        buffers = {".o": b"OBJ" * 5000, ".gcno": b"", "weird\n": b"\x00\xff"}
+        legacy = L.legacy_pack_keyed_buffers(buffers)
+        assert packing.pack_keyed_buffers_payload(buffers).join() == legacy
+        assert (packing.try_unpack_keyed_buffers_views(legacy)
+                == L.legacy_try_unpack_keyed_buffers(legacy))
+
+    def test_cache_entry_byte_identity(self):
+        for entry in [
+            CacheEntry(0, b"out", b"err\xff",
+                       files={".o": b"OBJ" * 40_000, ".gcno": b"N"},
+                       patches={".o": [(4, 32, b"/output.o")]}),
+            CacheEntry(1, b"", b"", files={}),
+            CacheEntry(0, b"", b"", files={".o": b""}),
+        ]:
+            legacy = L.legacy_write_cache_entry(entry)
+            assert write_cache_entry(entry) == legacy
+            assert write_cache_entry_payload(entry).join() == legacy
+            new_parsed = try_parse_cache_entry(legacy)
+            old_parsed = L.legacy_try_parse_cache_entry(legacy)
+            assert new_parsed is not None and old_parsed is not None
+            assert new_parsed.exit_code == old_parsed.exit_code
+            assert new_parsed.files == old_parsed.files
+            assert new_parsed.patches == old_parsed.patches
+
+    def test_cross_parse(self):
+        """New parser over legacy bytes and vice versa — the mixed
+        cluster in miniature, at the codec level."""
+        entry = CacheEntry(0, b"o", b"e", files={".o": b"X" * 10_000})
+        legacy_bytes = L.legacy_write_cache_entry(entry)
+        new_bytes = write_cache_entry(entry)
+        assert try_parse_cache_entry(legacy_bytes).files == entry.files
+        assert L.legacy_try_parse_cache_entry(new_bytes).files == entry.files
+
+    def test_copies_per_task_reduced_at_1mb(self):
+        """The acceptance counter: the modeled 1MB task round trip must
+        need >= 3 fewer full-buffer copies on the zero-copy path (it
+        actually drops ~13)."""
+        from yadcc_tpu.tools.dataplane_bench import model_task_copies
+
+        old = model_task_copies(1 << 20, legacy=True)
+        new = model_task_copies(1 << 20, legacy=False)
+        assert new <= old - 3, (old, new)
+        # And the new path's budget is pinned: the socket-boundary joins
+        # (submit body, servant RPC frame, reply frame, cache entry) —
+        # a regression shows up as a count bump, not a slow graph.
+        assert new <= 5, new
+
+
+class TestFusedDigestDecompress:
+    def test_digest_equality_across_chunk_splits(self):
+        data = b"struct S { int x; };\n" * 20_000
+        blob = compress.compress(data)
+        expect = digest_bytes(data)
+        for sizes in [[1, 2, 3], [7], [64], [1 << 12], [len(blob)]]:
+            r = compress.DecompressingDigestReader()
+            out = []
+            i = 0
+            k = 0
+            while i < len(blob):
+                step = sizes[k % len(sizes)]
+                out.append(r.feed(blob[i:i + step]))
+                i += step
+                k += 1
+            r.finish()
+            assert b"".join(out) == data
+            assert r.hexdigest() == expect
+
+    def test_output_cap_binds_mid_stream(self):
+        blob = compress.compress(b"\x00" * (8 << 20))
+        with pytest.raises(compress.CompressionError):
+            compress.decompress_and_digest(blob, max_output_size=1 << 20)
+        out, _ = compress.decompress_and_digest(blob,
+                                               max_output_size=16 << 20)
+        assert len(out) == 8 << 20
+
+    def test_corrupt_frame_error_parity(self):
+        blob = bytearray(compress.compress(b"x" * 100_000))
+        blob[len(blob) // 2] ^= 0xFF
+        assert compress.try_decompress(bytes(blob)) is None
+        with pytest.raises(compress.CompressionError):
+            compress.decompress_and_digest(bytes(blob))
+
+    def test_truncated_frame_raises(self):
+        blob = compress.compress(b"y" * 100_000)
+        with pytest.raises(compress.CompressionError):
+            compress.decompress_and_digest(blob[:len(blob) // 2])
+
+    def test_garbage_raises(self):
+        with pytest.raises(compress.CompressionError):
+            compress.decompress_and_digest(b"not a frame at all")
+
+
+class TestCompressLevelKnob:
+    def test_default_and_validation(self, monkeypatch):
+        monkeypatch.delenv("YTPU_COMPRESS_LEVEL", raising=False)
+        assert compress.current_level() == 3
+        monkeypatch.setenv("YTPU_COMPRESS_LEVEL", "1")
+        assert compress.current_level() == 1
+        for bad in ("0", "-3", "99", "fast", ""):
+            monkeypatch.setenv("YTPU_COMPRESS_LEVEL", bad)
+            assert compress.current_level() == 3
+
+    def test_levels_interoperate(self, monkeypatch):
+        data = b"int interop();\n" * 5000
+        monkeypatch.setenv("YTPU_COMPRESS_LEVEL", "1")
+        fast = compress.compress(data)
+        monkeypatch.delenv("YTPU_COMPRESS_LEVEL")
+        assert compress.decompress(fast) == data
+        out, digest = compress.decompress_and_digest(fast)
+        assert out == data and digest == digest_bytes(data)
+        # Client env accessor reports the same resolved value.
+        from yadcc_tpu.client.env_options import compress_level
+
+        monkeypatch.setenv("YTPU_COMPRESS_LEVEL", "5")
+        assert compress_level() == compress.current_level() == 5
+
+
+# ---------------------------------------------------------------------------
+# mixed old/new loopback cluster (the acceptance wire-compat proof)
+# ---------------------------------------------------------------------------
+
+
+def _compile_and_hit_cache(cluster, make_task_fn):
+    """One compile (exit 0, entry filled) + one cache hit on re-submit."""
+    tid = cluster.delegate.queue_task(make_task_fn())
+    r = cluster.delegate.wait_for_task(tid, 60)
+    assert r is not None and r.exit_code == 0
+    cluster.delegate.free_task(tid)
+    deadline = time.time() + 15
+    while time.time() < deadline and \
+            cluster.cache_service.inspect()["fills"] == 0:
+        time.sleep(0.1)
+    assert cluster.cache_service.inspect()["fills"] == 1, \
+        "cache entry never landed"
+    cluster.cache_reader.sync_once()
+    before = cluster.delegate.inspect()["stats"]
+    tid = cluster.delegate.queue_task(make_task_fn())
+    r = cluster.delegate.wait_for_task(tid, 60)
+    assert r is not None and r.exit_code == 0
+    cluster.delegate.free_task(tid)
+    after = cluster.delegate.inspect()["stats"]
+    assert after["hit_cache"] == before["hit_cache"] + 1
+    assert after["actually_run"] == before["actually_run"]
+
+
+def _mixed_cluster_case(tmp_path, patches_ctx):
+    from yadcc_tpu.common.hashing import digest_file
+    from yadcc_tpu.daemon.local.cxx_task import CxxCompilationTask
+    from yadcc_tpu.testing import LocalCluster, make_fake_compiler
+
+    compiler = make_fake_compiler(str(tmp_path / "bin"))
+    cd = digest_file(compiler)
+    with patches_ctx:
+        cluster = LocalCluster(tmp_path, n_servants=1,
+                               servant_concurrency=2,
+                               compiler_dirs=[str(tmp_path / "bin")])
+        try:
+            src = b"int mixed_cluster();" + b"// pad\n" * 2000
+
+            def make_task():
+                return CxxCompilationTask(
+                    requestor_pid=1, source_path="/src/mix.cc",
+                    source_digest=digest_bytes(src),
+                    invocation_arguments="-O2", cache_control=1,
+                    compiler_digest=cd,
+                    compressed_source=compress.compress(src))
+
+            _compile_and_hit_cache(cluster, make_task)
+        finally:
+            cluster.stop()
+
+
+def test_mixed_cluster_legacy_servant_new_delegate(tmp_path):
+    """Servant produces frames/entries with the PRE-PR path; the
+    zero-copy delegate must consume them: compile round-trips and the
+    legacy-written cache entry reads back as a hit."""
+    _mixed_cluster_case(tmp_path, L.servant_legacy_patches())
+
+
+def test_mixed_cluster_new_servant_legacy_delegate(tmp_path):
+    """Zero-copy servant, pre-PR delegate parsers — the other half of
+    the wire-compat matrix."""
+    _mixed_cluster_case(tmp_path, L.delegate_legacy_patches())

@@ -52,6 +52,16 @@ if [ "${YTPU_CI_SKIP_NATIVE:-}" != 1 ]; then
   fi
 fi
 
+echo "== dataplane parity smoke =="
+# Wire/cache-format compatibility gate: the zero-copy path must produce
+# byte-identical frames and entries to the legacy path, and cut copies
+# per task (doc/benchmarks.md "Data plane").  Gates on PARITY, never on
+# speed — exit 2 from the tool means the formats diverged.
+if ! python -m yadcc_tpu.tools.dataplane_bench --smoke; then
+  echo "dataplane parity smoke FAILED" >&2
+  fail=1
+fi
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 "${YTPU_CI_TEST_TIMEOUT:-870}" \

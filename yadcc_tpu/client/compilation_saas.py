@@ -19,7 +19,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..common.compress import try_decompress
 from ..common.hashing import digest_file
-from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+from ..common.multi_chunk import (make_multi_chunk_payload,
+                                  try_parse_multi_chunk_views)
 from . import logging as log
 from .daemon_call import call_daemon
 from .compiler_args import CompilerArgs
@@ -49,7 +50,7 @@ def submit_compilation_task(
     compiler_path: str,
     source_path: str,
     source_digest: str,
-    compressed_source: bytes,
+    compressed_source,  # bytes-like or common.payload.Payload
     invocation_arguments: str,
     cache_control: int,
     ignore_timestamp_macros: bool = False,
@@ -64,7 +65,10 @@ def submit_compilation_task(
         "ignore_timestamp_macros": ignore_timestamp_macros,
         "compiler": _file_desc(compiler_path),
     }
-    body = make_multi_chunk([json.dumps(msg).encode(), compressed_source])
+    # Gather framing: the compressor's output blocks become body
+    # segments directly; call_daemon flattens once at the socket.
+    body = make_multi_chunk_payload(
+        [json.dumps(msg).encode(), compressed_source])
     for attempt in range(2):
         resp = call_daemon("POST", "/local/submit_cxx_task", body,
                            timeout_s=10.0)
@@ -104,10 +108,10 @@ def wait_for_compilation_task(
             continue  # still running
         if resp.status != 200:
             raise CloudError(f"wait failed: HTTP {resp.status}")
-        chunks = try_parse_multi_chunk(resp.body)
+        chunks = try_parse_multi_chunk_views(resp.body)
         if not chunks:
             raise CloudError("malformed wait response")
-        meta = json.loads(chunks[0])
+        meta = json.loads(bytes(chunks[0]))
         files: Dict[str, bytes] = {}
         exts = meta.get("file_extensions", [])
         patches = {p["file_key"]: p.get("locations", [])

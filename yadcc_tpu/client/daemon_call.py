@@ -11,6 +11,7 @@ import http.client
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..common.payload import Payload
 from .env_options import daemon_port
 
 
@@ -31,9 +32,16 @@ def set_daemon_call_handler(
     _handler = handler
 
 
-def call_daemon(method: str, path: str, body: bytes = b"",
+def call_daemon(method: str, path: str, body=b"",
                 timeout_s: float = 30.0) -> DaemonResponse:
-    """Returns status -1 on connection failure (daemon not running)."""
+    """Returns status -1 on connection failure (daemon not running).
+
+    `body` may be a chunked Payload; this is the client's socket
+    boundary, so it is flattened here — exactly once — for the
+    Content-Length HTTP write (and for the injected test handler, which
+    stands in for the wire)."""
+    if isinstance(body, Payload):
+        body = body.join()
     if _handler is not None:
         return _handler(method, path, body)
     try:

@@ -32,6 +32,11 @@ configuration is environment variables:
                            1 = stdin-sourced compiles take lightweight
                            quota (they're usually configure-time
                            feature probes, not real TUs)
+    YTPU_COMPRESS_LEVEL    zstd level for the preprocessed-source
+                           stream (default 3, the reference's
+                           throughput-over-ratio tune — doc/cache.md);
+                           out-of-range or unparsable values fall back
+                           to the default
 """
 
 from __future__ import annotations
@@ -104,3 +109,13 @@ def debugging_compile_locally() -> bool:
 
 def treat_stdin_as_lightweight() -> bool:
     return _int_env("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", 0) == 1
+
+
+def compress_level() -> int:
+    """Validated YTPU_COMPRESS_LEVEL (the actual clamp lives in
+    common.compress.current_level, which every compression call site
+    reads — this accessor exists so client code and diagnostics report
+    the same resolved value the compressor will use)."""
+    from ..common.compress import current_level
+
+    return current_level()

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 from ..common import cacheability
 from ..common.compress import CompressingWriter, TeeWriter
 from ..common.hashing import DigestingWriter
+from ..common.payload import Payload
 from . import logging as log
 from .command import execute_command
 from .compiler_args import CompilerArgs
@@ -26,7 +27,10 @@ from .compiler_args import CompilerArgs
 
 @dataclass
 class RewriteResult:
-    compressed_source: bytes
+    # Chunked payload of the compressor's output blocks, exactly as they
+    # streamed out of the preprocess pipe — handed segment-for-segment
+    # to the submit framing; nothing joins them before the socket.
+    compressed_source: Payload
     source_digest: str
     uncompressed_size: int
     directives_only: bool  # servant must compile with matching flags
@@ -91,7 +95,7 @@ def _run_preprocess(compiler: str, tail: List[str]) -> Optional[RewriteResult]:
         return None
     zw.close()
     return RewriteResult(
-        compressed_source=b"".join(collector.chunks),
+        compressed_source=Payload(collector.chunks),
         source_digest=digester.hexdigest(),
         uncompressed_size=digester.bytes_written,
         directives_only=False,  # caller fills in

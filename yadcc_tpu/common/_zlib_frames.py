@@ -78,6 +78,50 @@ class StreamCompressor:
         return self._prefix(self._obj.flush())
 
 
+class AnyFrameDecompressor:
+    """Streaming twin of :func:`decompress`: accepts EITHER frame kind
+    (one-shot ``YZF1`` or streaming ``YZFS``) fed in arbitrary chunk
+    sizes — the engine under compress.DecompressingDigestReader when the
+    zstd wheel is absent.  Error semantics match the one-shot path:
+    truncation and declared-size mismatch raise :class:`Error`; trailing
+    bytes after the stream end are ignored (zlib routes them to
+    ``unused_data``), exactly as ``decompress`` accepts them."""
+
+    def __init__(self):
+        self._obj = zlib.decompressobj()
+        self._head = b""
+        self._declared = None  # None until the magic is seen; -1 = stream
+        self._out = 0
+
+    def decompress(self, chunk) -> bytes:
+        if self._declared is None:
+            self._head += bytes(chunk)
+            if len(self._head) < 4:
+                return b""
+            if self._head[:4] == _STREAM_MAGIC:
+                self._declared = -1
+                chunk, self._head = self._head[4:], b""
+            elif self._head[:4] == _ONE_SHOT_MAGIC:
+                if len(self._head) < 12:
+                    return b""
+                self._declared = int.from_bytes(self._head[4:12], "little")
+                chunk, self._head = self._head[12:], b""
+            else:
+                raise Error("not a framed payload")
+        try:
+            out = self._obj.decompress(chunk)
+        except zlib.error as e:
+            raise Error(str(e)) from None
+        self._out += len(out)
+        return out
+
+    def verify_eof(self) -> None:
+        if self._declared is None or not self._obj.eof:
+            raise Error("truncated stream")
+        if self._declared >= 0 and self._out != self._declared:
+            raise Error("declared size mismatch")
+
+
 class StreamDecompressor:
     """decompressobj() twin for decompress_iter."""
 

@@ -12,7 +12,8 @@ be part of anyway (the wire peer would need the same build)."""
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+import os
+from typing import Iterable, Optional, Tuple
 
 try:
     import zstandard
@@ -20,9 +21,17 @@ except ImportError:  # gated: minimal containers ship no zstd wheel
     zstandard = None
     from . import _zlib_frames as _fallback
 
+from .hashing import new_digest
+
 # Reference tunes for throughput, not ratio: zstd eats ~15% of client CPU
 # at the default level (yadcc/doc/rationale.md:94).
 _LEVEL = 3
+
+# YTPU_COMPRESS_LEVEL bounds; values outside fall back to the default
+# rather than erroring (a typo'd env var must not break every compile).
+# zstd's ultra levels (20+) need window-log opt-ins and are never a
+# throughput tune; the zlib stand-in caps at 9.
+_MAX_LEVEL = 19 if zstandard is not None else 9
 
 # The error type callers may catch regardless of which backend is
 # compiled in (zstandard.ZstdError when the wheel is present).
@@ -37,20 +46,38 @@ import threading
 _tls = threading.local()
 
 
+def current_level() -> int:
+    """Active compression level: YTPU_COMPRESS_LEVEL when it parses to a
+    level the backend supports, else the reference's throughput tune
+    (3).  Read per call so tests (and long-lived daemons told to
+    re-exec) see env changes; the parse costs nanoseconds against any
+    payload worth compressing."""
+    raw = os.environ.get("YTPU_COMPRESS_LEVEL")
+    if not raw:
+        return _LEVEL
+    try:
+        v = int(raw)
+    except ValueError:
+        return _LEVEL
+    return v if 1 <= v <= _MAX_LEVEL else _LEVEL
+
+
 def _ctx() -> tuple:
-    pair = getattr(_tls, "pair", None)
-    if pair is None:
-        pair = (
-            zstandard.ZstdCompressor(level=_LEVEL),
+    level = current_level()
+    trio = getattr(_tls, "trio", None)
+    if trio is None or trio[0] != level:
+        trio = (
+            level,
+            zstandard.ZstdCompressor(level=level),
             zstandard.ZstdDecompressor(),
         )
-        _tls.pair = pair
-    return pair
+        _tls.trio = trio
+    return trio[1:]
 
 
 def compress(data: bytes) -> bytes:
     if zstandard is None:
-        return _fallback.compress(data, _LEVEL)
+        return _fallback.compress(data, current_level())
     return _ctx()[0].compress(data)
 
 
@@ -93,9 +120,10 @@ class CompressingWriter:
 
     def __init__(self, sink):
         self._sink = sink
-        self._obj = (_fallback.StreamCompressor(_LEVEL)
+        level = current_level()
+        self._obj = (_fallback.StreamCompressor(level)
                      if zstandard is None
-                     else zstandard.ZstdCompressor(level=_LEVEL)
+                     else zstandard.ZstdCompressor(level=level)
                      .compressobj())
         self._closed = False
 
@@ -129,3 +157,70 @@ def decompress_iter(chunks: Iterable[bytes]) -> bytes:
     obj = (_fallback.StreamDecompressor() if zstandard is None
            else _ctx()[1].decompressobj())
     return b"".join(obj.decompress(c) for c in chunks)
+
+
+class DecompressingDigestReader:
+    """Fused streaming decompress ⊕ BLAKE2b-256 — one pass over the
+    bytes instead of decompress-everything-then-rescan-to-digest.
+
+    The servant-side mirror of the client's compress⊕digest tee
+    (CompressingWriter + hashing.DigestingWriter): feed compressed
+    chunks with :meth:`feed`, each decompressed piece is digested as it
+    appears; :meth:`finish` verifies stream completeness.  The output
+    cap binds on *produced* bytes, so a hostile frame aborts mid-stream
+    instead of after a giant allocation.  All failures raise
+    :data:`CompressionError`; callers discard any partial output.
+    """
+
+    def __init__(self, max_output_size: int = _MAX_DECOMPRESSED):
+        self._h = new_digest()
+        self._cap = max_output_size
+        self.bytes_out = 0
+        self._obj = (_fallback.AnyFrameDecompressor() if zstandard is None
+                     else zstandard.ZstdDecompressor().decompressobj())
+
+    def feed(self, chunk) -> bytes:
+        out = self._obj.decompress(chunk)
+        self.bytes_out += len(out)
+        if self.bytes_out > self._cap:
+            raise CompressionError(f"output exceeds cap {self._cap}")
+        if out:
+            self._h.update(out)
+        return out
+
+    def finish(self) -> None:
+        if zstandard is None:
+            self._obj.verify_eof()
+        elif not getattr(self._obj, "eof", True):
+            raise CompressionError("truncated stream")
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()
+
+
+def decompress_and_digest(
+    data,
+    max_output_size: int = _MAX_DECOMPRESSED,
+    chunk_size: int = 1 << 20,
+) -> Tuple[bytes, str]:
+    """Single-pass (decompressed bytes, hex digest) of a complete frame.
+
+    Error contract matches :func:`decompress` — corruption, truncation,
+    a hostile declared size, or cap overflow raise
+    :data:`CompressionError`; no partial output escapes."""
+    mv = memoryview(data)
+    # Same fail-fast declared-size check as decompress(): a tiny frame
+    # declaring terabytes is refused before any work.
+    declared = (_fallback.frame_content_size(mv) if zstandard is None
+                else zstandard.frame_content_size(data))
+    if declared > max_output_size:
+        raise CompressionError(
+            f"declared content size {declared} exceeds cap")
+    reader = DecompressingDigestReader(max_output_size)
+    pieces = []
+    for off in range(0, len(mv), chunk_size):
+        out = reader.feed(mv[off:off + chunk_size])
+        if out:
+            pieces.append(out)
+    reader.finish()
+    return b"".join(pieces), reader.hexdigest()

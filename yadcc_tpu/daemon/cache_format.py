@@ -23,8 +23,9 @@ import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..common.hashing import digest_bytes
-from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+from ..common.hashing import new_digest
+from ..common.multi_chunk import try_parse_multi_chunk_views
+from ..common.payload import Payload
 from .task_digest import get_cxx_task_digest
 
 _MAGIC = b"YTC2"
@@ -40,7 +41,8 @@ class CacheEntry:
     exit_code: int
     standard_output: bytes
     standard_error: bytes
-    # file key (extension like ".o") -> zstd-compressed content.
+    # file key (extension like ".o") -> zstd-compressed content
+    # (bytes-like: parsed entries hand back views into the entry buffer).
     files: Dict[str, bytes]
     # file key -> [(position, total_size, suffix_to_keep)].
     patches: Dict[str, List[Tuple[int, int, bytes]]] = field(
@@ -53,10 +55,19 @@ def get_cache_key(compiler_digest: str, invocation_arguments: str,
         compiler_digest, invocation_arguments, source_digest)
 
 
-def write_cache_entry(entry: CacheEntry) -> bytes:
+def write_cache_entry_payload(entry: CacheEntry) -> Payload:
+    """Gather form: [magic+len+meta] ++ [chunk header] ++ file buffers.
+
+    The integrity digest is fed incrementally (meta, then the body
+    segments) instead of materializing ``canonical + body`` — for a
+    multi-MB object that concatenation was a full extra copy of the
+    entry just to hash it.  Wire bytes are identical to the historical
+    single-buffer writer (parity-tested)."""
     file_keys = sorted(entry.files)
     chunks = [entry.files[k] for k in file_keys]
-    body = make_multi_chunk(chunks)
+    # The multi-chunk body = length header + concatenated chunks; keep
+    # the header as its own segment so chunks are never copied.
+    body_header = ",".join(str(len(c)) for c in chunks).encode() + b"\r\n"
     meta = {
         "exit_code": entry.exit_code,
         "stdout_hex": entry.standard_output.hex(),
@@ -69,26 +80,45 @@ def write_cache_entry(entry: CacheEntry) -> bytes:
     }
     # Digest over the serialized meta (sort_keys: canonical form) plus
     # the body, so every field is integrity-protected.
-    canonical = json.dumps(meta, sort_keys=True).encode()
-    meta["entry_digest"] = digest_bytes(canonical + body)
+    h = new_digest()
+    h.update(json.dumps(meta, sort_keys=True).encode())
+    h.update(body_header)
+    for c in chunks:
+        h.update(c)
+    meta["entry_digest"] = h.hexdigest()
     meta_bytes = json.dumps(meta).encode()
-    return _MAGIC + _LEN.pack(len(meta_bytes)) + meta_bytes + body
+    return Payload.of(_MAGIC + _LEN.pack(len(meta_bytes)) + meta_bytes,
+                      body_header, *chunks)
 
 
-def try_parse_cache_entry(data: bytes) -> Optional[CacheEntry]:
-    """None on any corruption — a bad entry must read as a miss."""
+def write_cache_entry(entry: CacheEntry) -> bytes:
+    return write_cache_entry_payload(entry).join()
+
+
+def try_parse_cache_entry(data) -> Optional[CacheEntry]:
+    """None on any corruption — a bad entry must read as a miss.
+
+    Accepts ``bytes``, a ``memoryview`` (an RPC attachment still backed
+    by its frame) or a ``Payload``; file contents come back as views
+    into the entry buffer — one digest pass, zero body copies."""
     try:
-        if not data.startswith(_MAGIC):
+        if isinstance(data, Payload):
+            data = data.join()
+        mv = memoryview(data)
+        if bytes(mv[:4]) != _MAGIC:
             return None
-        (meta_len,) = _LEN.unpack_from(data, 4)
+        (meta_len,) = _LEN.unpack_from(mv, 4)
         meta_end = 8 + meta_len
-        meta = json.loads(data[8:meta_end])
-        body = data[meta_end:]
+        meta = json.loads(bytes(mv[8:meta_end]))
+        body = mv[meta_end:]
         claimed = meta.pop("entry_digest")
         canonical = json.dumps(meta, sort_keys=True).encode()
-        if claimed != digest_bytes(canonical + body):
+        h = new_digest()
+        h.update(canonical)
+        h.update(body)
+        if claimed != h.hexdigest():
             return None  # integrity failure (meta or body tampered)
-        chunks = try_parse_multi_chunk(body)
+        chunks = try_parse_multi_chunk_views(body)
         if chunks is None or len(chunks) != len(meta["file_keys"]):
             return None
         return CacheEntry(

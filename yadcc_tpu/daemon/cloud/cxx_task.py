@@ -23,12 +23,15 @@ with no network or shared state — pure subprocess work.
 from __future__ import annotations
 
 import shlex
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ...common import compress
-from ...common.hashing import digest_bytes
-from ..cache_format import CacheEntry, get_cache_key, write_cache_entry
+from ...common.payload import Payload
+from .. import cache_format
+from ..cache_format import CacheEntry, get_cache_key
 from ..task_digest import get_cxx_task_digest
 from .execution_engine import TaskOutput
 from .temporary import TemporaryDir
@@ -42,6 +45,46 @@ _PADDED_WORKSPACE_LEN = 224
 # Shared with the client's YTPU_WARN_ON_NONCACHEABLE diagnostic, so the
 # warning can never disagree with the authoritative decision made here.
 from ...common.cacheability import scan_source_cacheability  # noqa: E402,F401
+
+
+class _PackExecutor:
+    """Lazy shared thread pool for servant output packing.
+
+    One small pool per process, shared by every completing task: a TU
+    producing several outputs (.o + .gcno + .su under --coverage /
+    -fstack-usage) compresses them concurrently instead of serially on
+    the waiter thread, and the cache-entry pack overlaps workspace
+    cleanup.  Sized small — compression is CPU work and the compile
+    subprocesses own most of the machine."""
+
+    def __init__(self, max_workers: int = 4):
+        self._max_workers = max_workers
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None  # guarded by: self._lock
+
+    def get(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="output-pack")
+            return self._pool
+
+
+_PACK_EXECUTOR = _PackExecutor()
+
+
+def _decompress_and_digest(data) -> Tuple[bytes, str]:
+    """Module-level seam: the fused single-pass source intake (swapped
+    for the two-pass legacy path in dataplane A/B runs)."""
+    return compress.decompress_and_digest(data)
+
+
+def _pack_one_output(content: bytes, needle: bytes) -> Tuple[
+        List[Tuple[int, int, bytes]], bytes]:
+    """(patch locations, compressed content) for one produced file —
+    the unit of work fanned out on the shared pack executor."""
+    return find_patch_locations(content, needle), compress.compress(content)
 
 
 def find_patch_locations(
@@ -90,11 +133,16 @@ class CloudCxxCompilationTask:
     # -- prepare -------------------------------------------------------------
 
     def prepare(self, compressed_source: bytes) -> None:
-        src = compress.try_decompress(compressed_source)
-        if src is None:
+        # Fused single pass: each decompressed piece is digested as it
+        # is produced, instead of materializing the source and then
+        # re-scanning all of it for the digest (the attachment arrives
+        # as a view into the RPC frame — no copy on the way in either).
+        try:
+            src, self.source_digest = _decompress_and_digest(
+                compressed_source)
+        except (compress.CompressionError, MemoryError, ValueError):
             raise ValueError("source attachment is not valid zstd")
         self.source = src
-        self.source_digest = digest_bytes(src)
         self.cacheable = (not self.disallow_cache_fill) and (
             self.ignore_timestamp_macros
             or scan_source_cacheability(src, self.invocation_arguments))
@@ -144,31 +192,45 @@ class CloudCxxCompilationTask:
     def collect_outputs(self, output: TaskOutput) -> Tuple[
         Dict[str, bytes],
         Dict[str, List[Tuple[int, int, bytes]]],
-        Optional[bytes],
+        Optional[Payload],
     ]:
         """(compressed files by extension, patch locations by extension,
-        serialized cache entry or None).  Cleans up the workspace."""
+        cache-entry payload or None).  Cleans up the workspace.
+
+        Per-file patch-scan + compression fans out on the shared pack
+        executor (a --coverage TU's .o/.gcno/.su pack in parallel); the
+        cache-entry pack runs there too, overlapping workspace removal.
+        The entry is a gather Payload sharing the compressed file
+        buffers — the servant never flattens it (the cache-fill RPC
+        joins it once at the socket)."""
         assert self.workspace is not None
         files: Dict[str, bytes] = {}
         patches: Dict[str, List[Tuple[int, int, bytes]]] = {}
         needle = self.workspace.path.encode()
         if output.exit_code == 0:
+            pool = _PACK_EXECUTOR.get()
+            jobs = []
             for rel, content in self.workspace.read_all_files().items():
                 if rel == f"src{self._source_ext}":
                     continue  # the input, not a product
                 ext = "." + rel.split(".", 1)[1] if "." in rel else rel
-                locs = find_patch_locations(content, needle)
+                jobs.append((ext, pool.submit(_pack_one_output, content,
+                                              needle)))
+            for ext, fut in jobs:
+                locs, compressed = fut.result()
                 if locs:
                     patches[ext] = locs
-                files[ext] = compress.compress(content)
-        entry_bytes = None
+                files[ext] = compressed
+        entry_future = None
         if output.exit_code == 0 and self.cacheable:
-            entry_bytes = write_cache_entry(CacheEntry(
-                exit_code=output.exit_code,
-                standard_output=output.standard_output,
-                standard_error=output.standard_error,
-                files=files,
-                patches=patches,
-            ))
+            entry_future = _PACK_EXECUTOR.get().submit(
+                cache_format.write_cache_entry_payload, CacheEntry(
+                    exit_code=output.exit_code,
+                    standard_output=output.standard_output,
+                    standard_error=output.standard_error,
+                    files=files,
+                    patches=patches,
+                ))
         self.workspace.remove()
-        return files, patches, entry_bytes
+        return files, patches, (entry_future.result()
+                                if entry_future is not None else None)

@@ -20,8 +20,8 @@ from ... import api
 from ...rpc import Channel, RpcContext, RpcError, ServiceSpec
 from ...utils.logging import get_logger
 from ...version import VERSION_FOR_UPGRADE
+from .. import packing
 from ..config import DaemonConfig
-from ..packing import pack_keyed_buffers
 from ..sysinfo import (
     LoadAverageSampler,
     read_memory_available,
@@ -219,7 +219,10 @@ class DaemonService:
             for pos, total, suffix in locs:
                 pl.locations.add(position=pos, total_size=total,
                                  suffix_to_keep=suffix)
-        ctx.response_attachment = pack_keyed_buffers(result.files)
+        # Gather attachment: the compressed output buffers ride as
+        # payload segments; the transport flattens once at the socket.
+        ctx.response_attachment = packing.pack_keyed_buffers_payload(
+            result.files)
         return resp
 
     def FreeTask(self, req, attachment, ctx):

@@ -16,8 +16,8 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ... import api
-from ..cache_format import get_cache_key, try_parse_cache_entry
-from ..packing import try_unpack_keyed_buffers
+from .. import cache_format, packing
+from ..cache_format import get_cache_key
 from ..task_digest import get_cxx_task_digest
 from .distributed_task import DistributedTask, TaskResult
 
@@ -37,6 +37,8 @@ class CxxCompilationTask(DistributedTask):
     invocation_arguments: str
     cache_control: int  # 0 off, 1 on, 2 = refill (skip reads, still fill)
     compiler_digest: str
+    # bytes-like: the HTTP layer hands a view into the request body, so
+    # the source is never copied between loopback receive and RPC send.
     compressed_source: bytes
     ignore_timestamp_macros: bool = False
 
@@ -78,8 +80,11 @@ class CxxCompilationTask(DistributedTask):
             attachment=self.compressed_source, timeout=30.0)
         return resp.task_id
 
-    def parse_servant_output(self, resp, attachment: bytes) -> TaskResult:
-        files = try_unpack_keyed_buffers(attachment) or {}
+    def parse_servant_output(self, resp, attachment) -> TaskResult:
+        # Views into the reply frame — output files are not copied out
+        # of the attachment; they flow into the client-facing response
+        # (or the .o write) still backed by the one received buffer.
+        files = packing.try_unpack_keyed_buffers_views(attachment) or {}
         patches = {
             pl.file_key: [
                 (loc.position, loc.total_size, loc.suffix_to_keep)
@@ -95,8 +100,8 @@ class CxxCompilationTask(DistributedTask):
             patches=patches,
         )
 
-    def parse_cache_entry(self, data: bytes) -> Optional[TaskResult]:
-        entry = try_parse_cache_entry(data)
+    def parse_cache_entry(self, data) -> Optional[TaskResult]:
+        entry = cache_format.try_parse_cache_entry(data)
         if entry is None:
             return None
         return TaskResult(

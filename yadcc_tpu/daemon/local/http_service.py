@@ -25,7 +25,8 @@ from typing import Callable, Optional
 from google.protobuf import json_format
 
 from ... import api
-from ...common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+from ...common import multi_chunk
+from ...common.payload import Payload
 from ...utils.logging import get_logger
 from ...version import BUILT_AT, VERSION_FOR_UPGRADE
 from .cxx_task import NeedCompilerDigest, make_cxx_task
@@ -73,13 +74,20 @@ class LocalHttpService:
             def log_message(self, *args):
                 pass
 
-            def _reply(self, code: int, body: bytes = b"",
+            def _reply(self, code: int, body=b"",
                        content_type: str = "application/json"):
+                # `body` may be a chunked Payload: gather-write its
+                # segments (wfile buffers small ones; a multi-MB object
+                # file goes straight from the servant-reply buffer to
+                # the socket, never joined).
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                if body:
+                if isinstance(body, Payload):
+                    for seg in body.iter_segments():
+                        self.wfile.write(seg)
+                elif body:
                     self.wfile.write(body)
 
             def do_GET(self):
@@ -145,11 +153,13 @@ class LocalHttpService:
             handler._reply(200, _to_json(api.local.SetFileDigestResponse()))
             return
         if path == "/local/submit_cxx_task":
-            chunks = try_parse_multi_chunk(body)
+            # Views: the (possibly multi-MB) source chunk stays a view
+            # into the request body all the way to the servant RPC.
+            chunks = multi_chunk.try_parse_multi_chunk_views(body)
             if not chunks or len(chunks) != 2:
                 handler._reply(400, b'{"error":"expect json+source chunks"}')
                 return
-            req = _from_json(api.local.SubmitCxxTaskRequest, chunks[0])
+            req = _from_json(api.local.SubmitCxxTaskRequest, bytes(chunks[0]))
             try:
                 task = make_cxx_task(req, chunks[1], self.digest_cache)
             except NeedCompilerDigest:
@@ -185,7 +195,7 @@ class LocalHttpService:
                 chunks.append(result.files[key])
             chunks[0] = _to_json(resp)
             self.dispatcher.free_task(req.task_id)
-            handler._reply(200, make_multi_chunk(chunks),
+            handler._reply(200, multi_chunk.make_multi_chunk_payload(chunks),
                            content_type="application/octet-stream")
             return
         handler._reply(404)

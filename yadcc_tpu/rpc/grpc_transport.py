@@ -26,7 +26,7 @@ from .transport import (
     ServiceSpec,
     STATUS_TIMEOUT,
     STATUS_TRANSPORT_FAILURE,
-    decode_frame,
+    decode_frame_views,
     dispatch_frame,
     encode_frame,
 )
@@ -119,6 +119,9 @@ class GrpcChannel(Channel):
 
     def call(self, service, method_name, request, response_cls,
              attachment=b"", timeout=None):
+        # The socket boundary: encode_frame flattens header + meta +
+        # attachment segments exactly once (a Payload attachment arrives
+        # here never having been copied).
         frame = encode_frame(0, request.SerializeToString(), attachment)
         try:
             reply = self._callable(service, method_name)(frame, timeout=timeout)
@@ -128,9 +131,9 @@ class GrpcChannel(Channel):
                       if code == grpc.StatusCode.DEADLINE_EXCEEDED
                       else STATUS_TRANSPORT_FAILURE)
             raise RpcError(status, str(code)) from e
-        status, meta, att = decode_frame(reply)
+        status, meta, att = decode_frame_views(reply)
         if status != 0:
-            raise RpcError(status, meta.decode(errors="replace"))
+            raise RpcError(status, bytes(meta).decode(errors="replace"))
         return response_cls.FromString(meta), att
 
     def close(self) -> None:

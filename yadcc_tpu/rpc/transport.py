@@ -17,9 +17,15 @@ import struct
 import threading
 import time as _time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple, Union
+
+from ..common.payload import Payload, as_payload
 
 _HEADER = struct.Struct("<II")
+
+# Attachments travel as bytes-likes or chunked Payloads; the transport
+# flattens them exactly once, at the socket boundary.
+Attachment = Union[bytes, bytearray, memoryview, Payload]
 
 
 class RpcError(Exception):
@@ -44,8 +50,9 @@ class RpcContext:
     # Peer address as observed by the transport ("ip:port"), used e.g.
     # for the scheduler's NAT detection (observed vs reported endpoint).
     peer: str = ""
-    # Response attachment, set by the handler.
-    response_attachment: bytes = b""
+    # Response attachment, set by the handler — bytes or a chunked
+    # Payload (flattened once, into the reply frame).
+    response_attachment: Attachment = b""
 
 
 # A handler takes (request_message, request_attachment, context) and
@@ -87,12 +94,31 @@ def method(spec: ServiceSpec, request_cls: type):
     return deco
 
 
-def encode_frame(status: int, meta: bytes, attachment: bytes = b"") -> bytes:
-    # join over `+`: one allocation for the reply instead of two
-    # intermediate concatenation copies on the grant-reply hot path.
-    if not attachment:
-        return _HEADER.pack(status, len(meta)) + meta
-    return b"".join((_HEADER.pack(status, len(meta)), meta, attachment))
+def encode_frame_payload(status: int, meta: bytes,
+                         attachment: Attachment = b"") -> Payload:
+    """Gather form of a wire frame: [header+meta] ++ attachment segments.
+
+    The attachment's buffers are referenced, never copied — the single
+    flatten happens in the caller's ``join()`` at the socket boundary
+    (header and meta are small; packing them into one segment keeps the
+    hot no-attachment case a single allocation)."""
+    return Payload.of(_HEADER.pack(status, len(meta)) + meta,
+                      as_payload(attachment))
+
+
+def encode_frame(status: int, meta: bytes,
+                 attachment: Attachment = b"") -> bytes:
+    return encode_frame_payload(status, meta, attachment).join()
+
+
+def decode_frame_views(data) -> Tuple[int, memoryview, memoryview]:
+    """Zero-copy decode: meta and attachment are views into ``data``
+    (which they pin alive — for a reply frame that is the buffer the
+    transport handed back anyway)."""
+    status, meta_len = _HEADER.unpack_from(data)
+    off = _HEADER.size
+    mv = memoryview(data)
+    return status, mv[off:off + meta_len], mv[off + meta_len:]
 
 
 def decode_frame(data: bytes) -> Tuple[int, bytes, bytes]:
@@ -126,7 +152,9 @@ def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> byte
     if ms is None:
         return encode_frame(STATUS_METHOD_NOT_FOUND, b"")
     try:
-        _, meta, attachment = decode_frame(data)
+        # Views, not slices: a multi-MB source attachment reaches the
+        # handler without being copied out of the request frame.
+        _, meta, attachment = decode_frame_views(data)
         req = ms.request_cls.FromString(meta)
     except Exception as e:
         return encode_frame(STATUS_TRANSPORT_FAILURE,
@@ -226,7 +254,7 @@ class _MockChannel(Channel):
         frame = encode_frame(0, request.SerializeToString(), attachment)
         reply = dispatch_frame(services[service], method_name, frame,
                                peer=self._peer)
-        status, meta, att = decode_frame(reply)
+        status, meta, att = decode_frame_views(reply)
         if status != 0:
-            raise RpcError(status, meta.decode(errors="replace"))
+            raise RpcError(status, bytes(meta).decode(errors="replace"))
         return response_cls.FromString(meta), att

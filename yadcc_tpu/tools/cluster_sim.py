@@ -29,11 +29,55 @@ from pathlib import Path
 import numpy as np
 
 
+def _parse_tu_size_dist(spec: str):
+    """Size sampler for --tu-size-dist: ``fixed:N``, ``uniform:MIN:MAX``,
+    or the ``byte-heavy`` preset (uniform 128KB..1MB — preprocessed-C++
+    scale TUs that make the byte path, not the control plane, the
+    bottleneck).  Returns sampler(rng) -> int, or None for the classic
+    tiny synthetic TUs."""
+    if not spec:
+        return None
+    if spec == "byte-heavy":
+        spec = "uniform:1048576:4194304"
+    kind, _, rest = spec.partition(":")
+    if kind == "fixed":
+        n = int(rest)
+        return lambda rng: n
+    if kind == "uniform":
+        lo_s, _, hi_s = rest.partition(":")
+        lo, hi = int(lo_s), int(hi_s)
+        return lambda rng: int(rng.integers(lo, hi + 1))
+    raise ValueError(f"bad --tu-size-dist {spec!r}")
+
+
+def _make_sized_sources(n_unique: int, sampler, rng):
+    """Unique TU sources at sampled sizes.  Content is code-like text —
+    repetitive tokens with per-line variation, compressing roughly like
+    preprocessed C++ (~10:1) rather than like random bytes — plus a
+    unique header so every TU digests differently."""
+    pool = b"".join(
+        b"inline int ytpu_fn_%d(int v) { return v * %d + %d; }\n"
+        % (i, i % 97, i % 13) for i in range(10000))
+    sources = []
+    for i in range(n_unique):
+        size = sampler(rng)
+        head = f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
+        body = b""
+        need = max(0, size - len(head))
+        off = int(rng.integers(0, max(1, len(pool) - 1)))
+        while len(body) < need:
+            body += pool[off:off + need - len(body)]
+            off = 0
+        sources.append(head + body)
+    return sources
+
+
 def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
         policy: str, in_flight: int = 0, compile_s: float = 0.05,
-        delegates: int = 1) -> dict:
+        delegates: int = 1, tu_size_dist: str = "") -> dict:
     from ..common import compress
     from ..common.hashing import digest_bytes, digest_file
+    from ..common.payload import copy_stats
     from ..daemon.local.cxx_task import CxxCompilationTask
     from ..testing import LocalCluster, make_fake_compiler
 
@@ -55,8 +99,12 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
     rng = np.random.default_rng(1)
     n_unique = max(1, int(tasks * (1.0 - dup_rate)))
-    sources = [f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
-               for i in range(n_unique)]
+    sampler = _parse_tu_size_dist(tu_size_dist)
+    if sampler is None:
+        sources = [f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
+                   for i in range(n_unique)]
+    else:
+        sources = _make_sized_sources(n_unique, sampler, rng)
     picks = list(range(n_unique)) + list(
         rng.integers(0, n_unique, tasks - n_unique))
     # Interleave duplicates with their originals so some arrive while
@@ -113,6 +161,8 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
                 i = work.pop()
             submit_and_wait(i)
 
+    source_bytes_total = sum(len(sources[picks[i]]) for i in range(tasks))
+    copies0 = copy_stats()["copies"]
     try:
         t_start = time.perf_counter()
         threads = [threading.Thread(target=worker, daemon=True)
@@ -131,7 +181,7 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
         stats = {k: sum(d.inspect()["stats"][k] for d in all_delegates)
                  for k in ("hit_cache", "reused", "actually_run", "failed")}
-        return {
+        out = {
             "tasks": tasks,
             "delegates": delegates,
             "servants": servants,
@@ -144,6 +194,17 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
             "p99_latency_ms": pctl(99),
             "breakdown": stats,
         }
+        if tu_size_dist:
+            # Byte-heavy mode: the workload is about moving bytes, so
+            # report how many moved and how often they were copied
+            # (payload-layer meter, process-wide across the whole rig).
+            out["tu_size_dist"] = tu_size_dist
+            out["source_mb_total"] = round(source_bytes_total / 1e6, 1)
+            out["source_mb_per_sec"] = round(
+                source_bytes_total / 1e6 / wall, 1)
+            out["payload_copies_per_task"] = round(
+                (copy_stats()["copies"] - copies0) / max(1, tasks), 1)
+        return out
     finally:
         cluster.stop()
 
@@ -157,10 +218,17 @@ def main() -> None:
     ap.add_argument("--delegates", type=int, default=1,
                     help="simulated build machines (cross-machine dedup)")
     ap.add_argument("--policy", default="greedy_cpu")
+    ap.add_argument("--tu-size-dist", default="",
+                    help="TU size distribution: fixed:N, uniform:MIN:MAX,"
+                         " or 'byte-heavy' (uniform 128KB..1MB)")
+    ap.add_argument("--compile-s", type=float, default=0.05,
+                    help="fake compile duration per TU (seconds)")
     args = ap.parse_args()
     print(json.dumps(run(args.tasks, args.servants, args.concurrency,
                          args.dup_rate, args.policy,
-                         delegates=args.delegates), indent=2))
+                         compile_s=args.compile_s,
+                         delegates=args.delegates,
+                         tu_size_dist=args.tu_size_dist), indent=2))
 
 
 if __name__ == "__main__":
