@@ -390,8 +390,23 @@ def main() -> None:
     except Exception:
         dataplane_mb = None
 
+    # End-to-end jit-offload throughput (tools/cluster_sim --workload
+    # jit, fake worker): submissions/s through the full loopback farm.
+    # A control-plane canary for the second workload riding along with
+    # the scheduler numbers.
+    try:
+        from yadcc_tpu.tools.cluster_sim import quick_jit_compiles_per_sec
+
+        jit_cps = round(quick_jit_compiles_per_sec(), 1)
+    except Exception:
+        jit_cps = None
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 5 (r09+): adds `jit_compiles_per_sec` — end-to-end
+        # jit-offload submissions/s through the loopback farm with the
+        # deterministic fake worker (tools/cluster_sim --workload jit;
+        # doc/benchmarks.md "Jit offload").
         # Version 4 (r07+): adds `dataplane_mb_per_sec` (zero-copy
         # copy-path composite at 1MB, tools/dataplane_bench stage
         # definitions — see doc/benchmarks.md "Data plane").
@@ -403,7 +418,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 4,
+        "harness_version": 5,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),

@@ -488,6 +488,7 @@ def test_delegate_dispatcher_stats_updates_hold_lock():
 
     class BoomTask:
         requestor_pid = 0
+        kind = "boom"  # the SPI's class-level workload tag
 
         def get_env_digest(self):
             raise RuntimeError("boom")

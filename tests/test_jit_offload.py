@@ -1,0 +1,604 @@
+"""XLA jit-compilation offload: the second DistributedTask workload.
+
+SPI conformance (digest stability, cache-entry kind gating, the
+task-type registry, version-mismatch rejection), the loopback-cluster
+e2e contract (ISSUE 5 acceptance criteria: remote compile returns a
+byte-stable artifact, a second identical submission is a cache hit with
+``actually_run`` staying at 1, N concurrent identical submissions
+compile exactly once), lease-expiry kill without workspace leak, and a
+mixed cxx+jit run through one delegate.
+
+Every cluster test runs with YTPU_JIT_FAKE_WORKER=1: the worker's XLA
+invocation is replaced by a deterministic digest-derived artifact, so
+these tests exercise the farm (routing, dedup, cache, leases), not the
+XLA compiler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+from google.protobuf import json_format
+
+from yadcc_tpu import api
+from yadcc_tpu.common import compress, multi_chunk
+from yadcc_tpu.common.hashing import digest_bytes, digest_file
+from yadcc_tpu.daemon import cache_format
+from yadcc_tpu.daemon.cache_format import (
+    CacheEntry,
+    get_cache_key,
+    get_jit_cache_key,
+    try_parse_cache_entry,
+    write_cache_entry,
+)
+from yadcc_tpu.daemon.task_digest import (
+    get_cxx_task_digest,
+    get_jit_task_digest,
+)
+from yadcc_tpu.jit.env import jit_env_digest, local_jit_environment
+from yadcc_tpu.testing import LocalCluster, make_fake_compiler
+
+from .conftest import post_local
+
+HLO = b"module @jit_step { func.func public @main() { return } }"
+
+
+def make_jit_task(hlo: bytes = HLO, cache_control: int = 1,
+                  jaxlib_version: str = "", compile_options: bytes = b""):
+    from yadcc_tpu.daemon.local.jit_task import JitCompilationTask
+
+    return JitCompilationTask(
+        requestor_pid=1,
+        computation_digest=digest_bytes(hlo),
+        compile_options=compile_options,
+        backend="cpu",
+        jaxlib_version=(jaxlib_version
+                        or local_jit_environment("cpu").jaxlib_version),
+        cache_control=cache_control,
+        compressed_computation=compress.compress(hlo),
+    )
+
+
+# -- digest / key derivation --------------------------------------------------
+
+
+class TestDigests:
+    def test_jit_task_digest_is_stable(self):
+        a = get_jit_task_digest("env", b"opts", "comp")
+        assert a == get_jit_task_digest("env", b"opts", "comp")
+
+    def test_every_component_is_load_bearing(self):
+        base = get_jit_task_digest("env", b"opts", "comp")
+        assert get_jit_task_digest("env2", b"opts", "comp") != base
+        assert get_jit_task_digest("env", b"opts2", "comp") != base
+        assert get_jit_task_digest("env", b"opts", "comp2") != base
+
+    def test_domain_separation_from_cxx(self):
+        """Identical component strings must never produce the same
+        digest for both workloads (distinct keyed domains)."""
+        assert get_jit_task_digest("x", b"y", "z") != \
+            get_cxx_task_digest("x", "y", "z")
+
+    def test_cache_key_namespaces_are_disjoint(self):
+        jit = get_jit_cache_key("x", b"y", "z")
+        cxx = get_cache_key("x", "y", "z")
+        assert jit.startswith("ytpu-jit1-entry-")
+        assert cxx.startswith("ytpu-cxx2-entry-")
+
+    def test_env_digest_covers_backend_and_version(self):
+        base = jit_env_digest("cpu", "0.4.37")
+        assert jit_env_digest("tpu", "0.4.37") != base
+        assert jit_env_digest("cpu", "0.4.38") != base
+        assert jit_env_digest("cpu", "0.4.37") == base
+
+
+# -- cache-entry format: kind gating ------------------------------------------
+
+
+class TestCacheEntryKinds:
+    def test_jit_entry_round_trip(self):
+        entry = CacheEntry(exit_code=0, standard_output=b"out",
+                           standard_error=b"",
+                           files={".xla": b"artifact-bytes"},
+                           kind=cache_format.KIND_JIT)
+        parsed = try_parse_cache_entry(
+            write_cache_entry(entry), expect_kind=cache_format.KIND_JIT)
+        assert parsed is not None
+        assert parsed.kind == cache_format.KIND_JIT
+        assert bytes(parsed.files[".xla"]) == b"artifact-bytes"
+
+    def test_wrong_kind_reads_as_miss_both_ways(self):
+        jit_blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".xla": b"a"}, kind=cache_format.KIND_JIT))
+        cxx_blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".o": b"b"}))
+        # Default expect_kind is cxx: a jit entry must be a miss there.
+        assert try_parse_cache_entry(jit_blob) is None
+        assert try_parse_cache_entry(
+            cxx_blob, expect_kind=cache_format.KIND_JIT) is None
+        assert try_parse_cache_entry(cxx_blob) is not None
+
+    def test_cxx_wire_format_unchanged(self):
+        """kind is omitted for cxx entries so every historical entry
+        (and the dataplane A/B byte-parity gate) stays byte-identical."""
+        blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".o": b"obj"}))
+        assert b'"kind"' not in blob
+
+    def test_tampered_kind_fails_integrity(self):
+        """kind rides inside the digested meta: flipping it must fail
+        the integrity check, not reclassify the entry."""
+        blob = bytearray(write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".xla": b"a"}, kind=cache_format.KIND_JIT)))
+        pos = bytes(blob).find(b'"jit"')
+        assert pos > 0
+        blob[pos:pos + 5] = b'"cxx"'
+        assert try_parse_cache_entry(bytes(blob)) is None
+        assert try_parse_cache_entry(
+            bytes(blob), expect_kind=cache_format.KIND_JIT) is None
+
+
+# -- task-type registry -------------------------------------------------------
+
+
+class TestTaskRegistry:
+    def test_default_registry_serves_both_kinds(self):
+        from yadcc_tpu.daemon.local.file_digest_cache import \
+            FileDigestCache
+        from yadcc_tpu.daemon.local.task_registry import default_registry
+
+        reg = default_registry(FileDigestCache())
+        assert reg.kinds() == ["cxx", "jit"]
+        assert reg.for_submit("/local/submit_jit_task").kind == "jit"
+        assert reg.for_wait("/local/wait_for_cxx_task").kind == "cxx"
+        assert reg.for_submit("/local/unknown") is None
+
+    def test_duplicate_routes_rejected(self):
+        from yadcc_tpu.daemon.local.task_registry import (
+            TaskType,
+            TaskTypeRegistry,
+        )
+
+        def row(kind):
+            return TaskType(
+                kind=kind, submit_route="/local/submit_x",
+                wait_route=f"/local/wait_{kind}",
+                submit_request_cls=object, wait_request_cls=object,
+                make_task=lambda m, a: None,
+                build_wait_response=lambda r: (None, []),
+                submit_error=lambda e: None, bad_chunks_error=b"")
+
+        with pytest.raises(ValueError):
+            TaskTypeRegistry([row("a"), row("b")])
+
+
+# -- delegate-side task construction ------------------------------------------
+
+
+class TestMakeJitTask:
+    def test_missing_environment_raises(self):
+        from yadcc_tpu.daemon.local.jit_task import (
+            NeedJitEnvironment,
+            make_jit_task,
+        )
+
+        msg = api.jit.SubmitJitTaskRequest(
+            computation_digest="d", backend="cpu")  # no jaxlib_version
+        with pytest.raises(NeedJitEnvironment):
+            make_jit_task(msg, b"")
+
+    def test_missing_digest_raises(self):
+        from yadcc_tpu.daemon.local.jit_task import make_jit_task
+
+        msg = api.jit.SubmitJitTaskRequest(
+            backend="cpu", jaxlib_version="1")
+        with pytest.raises(ValueError):
+            make_jit_task(msg, b"")
+
+    def test_cache_disallow_yields_no_key(self):
+        task = make_jit_task(cache_control=0)
+        assert task.get_cache_key() is None
+        task = make_jit_task(cache_control=1)
+        assert task.get_cache_key().startswith("ytpu-jit1-entry-")
+
+
+# -- servant-side service: version gating + digest verification --------------
+
+
+@pytest.fixture
+def standalone_service(tmp_path, monkeypatch):
+    """A DaemonService with no cluster behind it: handlers are called
+    directly (the rig covers the wire; this covers the edges)."""
+    monkeypatch.setenv("YTPU_JIT_FAKE_WORKER", "1")
+    from yadcc_tpu.daemon.cloud.compiler_registry import CompilerRegistry
+    from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
+    from yadcc_tpu.daemon.cloud.execution_engine import ExecutionEngine
+    from yadcc_tpu.daemon.config import DaemonConfig
+
+    engine = ExecutionEngine(max_concurrency=2,
+                             min_memory_for_new_task=1)
+    service = DaemonService(
+        DaemonConfig(temporary_dir=str(tmp_path)),
+        engine=engine,
+        registry=CompilerRegistry(extra_dirs=[str(tmp_path / "nobin")]),
+        cgroup_present=False,
+        jit_environments=[local_jit_environment("cpu")])
+    service.set_acceptable_tokens_for_testing({"tkn"})
+    yield service
+    engine.stop()
+
+
+def _queue_req(env_digest: str, hlo: bytes = HLO,
+               claimed: str = "") -> "api.jit.QueueJitCompilationTaskRequest":
+    req = api.jit.QueueJitCompilationTaskRequest(
+        token="tkn", task_grant_id=7,
+        computation_digest=claimed or digest_bytes(hlo),
+        backend="cpu",
+        compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+    req.env_desc.compiler_digest = env_digest
+    return req
+
+
+class TestServantGating:
+    def test_version_mismatch_is_environment_not_available(
+            self, standalone_service):
+        """A submission for an XLA stack this servant doesn't serve is
+        refused with the same status a missing compiler gets — the
+        delegate-side NeedCompilerDigest-style retry contract."""
+        from yadcc_tpu.rpc import RpcError
+
+        bad = jit_env_digest("cpu", "some-other-jaxlib")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueJitCompilationTask(
+                _queue_req(bad), compress.compress(HLO), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE
+
+    def test_forged_computation_digest_rejected(self, standalone_service):
+        """A wrong claimed digest must fail fast — not compile and fill
+        the cache under the claimed key."""
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueJitCompilationTask(
+                _queue_req(env.digest, claimed="0" * 64),
+                compress.compress(HLO), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+    def test_garbage_attachment_rejected(self, standalone_service):
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueJitCompilationTask(
+                _queue_req(env.digest), b"not zstd at all", None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+    def test_heartbeat_advertises_jit_env(self, standalone_service):
+        env = local_jit_environment("cpu")
+        assert env.digest in [
+            e["digest"] for e in
+            standalone_service.inspect()["jit_environments"]]
+
+
+# -- lease expiry: the compile subprocess dies, the workspace doesn't leak ---
+
+
+def test_lease_expiry_kills_compile_no_workspace_leak(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("YTPU_JIT_FAKE_WORKER", "1")
+    monkeypatch.setenv("YTPU_JIT_FAKE_SLEEP_S", "60")
+    from yadcc_tpu.daemon.cloud.execution_engine import ExecutionEngine
+    from yadcc_tpu.daemon.cloud.jit_task import CloudJitCompilationTask
+
+    env = local_jit_environment("cpu")
+    task = CloudJitCompilationTask(
+        env_digest=env.digest, backend="cpu", compile_options=b"",
+        claimed_computation_digest=digest_bytes(HLO),
+        temp_root=str(tmp_path))
+    task.prepare(compress.compress(HLO))
+    ws = task.workspace.path
+    assert os.path.isdir(ws)
+
+    engine = ExecutionEngine(max_concurrency=1, min_memory_for_new_task=1)
+    done = threading.Event()
+    outputs = {}
+
+    def on_completion(task_id, output):
+        outputs["files"], _, outputs["entry"] = task.collect_outputs(output)
+        outputs["exit_code"] = output.exit_code
+        done.set()
+
+    try:
+        tid = engine.try_queue_task(
+            grant_id=42, digest=task.task_digest, cmdline=task.cmdline,
+            on_completion=on_completion, env=task.worker_env(), cwd=ws)
+        assert tid is not None
+        # Give the worker time to actually be mid-"compile" (sleeping).
+        time.sleep(1.0)
+        engine.kill_expired_tasks([42])
+        assert done.wait(timeout=20), "waiter never fired after SIGKILL"
+        assert outputs["exit_code"] != 0
+        assert outputs["files"] == {}  # no artifact from a killed worker
+        assert outputs["entry"] is None  # and no cache fill
+        assert not os.path.exists(ws), "workspace leaked after kill"
+    finally:
+        engine.stop()
+
+
+# -- loopback-cluster e2e -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jit_cluster(tmp_path_factory):
+    os.environ["YTPU_JIT_FAKE_WORKER"] = "1"
+    tmp = tmp_path_factory.mktemp("jit_e2e")
+    compiler_dir = tmp / "bin"
+    make_fake_compiler(str(compiler_dir))
+    c = LocalCluster(tmp, n_servants=1, servant_concurrency=4,
+                     compiler_dirs=[str(compiler_dir)])
+    c.compiler_dir = str(compiler_dir)
+    yield c
+    c.stop()
+    os.environ.pop("YTPU_JIT_FAKE_WORKER", None)
+
+
+def _submit(delegate, task, timeout_s=60.0):
+    tid = delegate.queue_task(task)
+    result = delegate.wait_for_task(tid, timeout_s)
+    delegate.free_task(tid)
+    return result
+
+
+def _wait_for_cache_hit(cluster, delegate, make, attempts=40):
+    """Loop sync→submit until the Bloom replica reflects the fill (the
+    10s background cadence is deliberately not waited for)."""
+    for _ in range(attempts):
+        time.sleep(0.25)
+        cluster.cache_reader.sync_once()
+        r = _submit(delegate, make())
+        if r is not None and r.from_cache:
+            return r
+    return None
+
+
+class TestJitClusterE2E:
+    def test_remote_compile_cache_hit_and_byte_stability(self,
+                                                         jit_cluster):
+        hlo = b"module @jit_a { func.func public @main() { return } }"
+        r1 = _submit(jit_cluster.delegate, make_jit_task(hlo))
+        assert r1 is not None and r1.exit_code == 0
+        artifact = compress.decompress(bytes(r1.files[".xla"]))
+        assert artifact.startswith(b"FAKEXLA1")
+        run0 = jit_cluster.servants[0].engine.tasks_run_ever
+
+        # A second client (own grant keeper, own running-task snapshot)
+        # submitting the identical computation must be served from the
+        # distributed cache without a servant compile.
+        d2 = jit_cluster.make_extra_delegate()
+        r2 = _wait_for_cache_hit(jit_cluster, d2,
+                                 lambda: make_jit_task(hlo))
+        assert r2 is not None, "second submission never hit the cache"
+        assert compress.decompress(bytes(r2.files[".xla"])) == artifact
+        assert jit_cluster.servants[0].engine.tasks_run_ever == run0
+        assert d2.inspect()["stats_by_kind"]["jit"]["hit_cache"] >= 1
+
+    def test_concurrent_identical_submissions_compile_once(
+            self, jit_cluster, monkeypatch):
+        """The thundering-herd case: two build machines jit the same
+        model step while it is still compiling — the join path must
+        share ONE servant execution (cache_control=0 so the cache
+        cannot shortcut the test)."""
+        monkeypatch.setenv("YTPU_JIT_FAKE_SLEEP_S", "4.0")
+        hlo = b"module @jit_b { func.func public @main() { return } }"
+        run0 = jit_cluster.servants[0].engine.tasks_run_ever
+        d2 = jit_cluster.make_extra_delegate()
+
+        def jit_stats(delegate):
+            return delegate.inspect()["stats_by_kind"].get(
+                "jit", {"actually_run": 0, "reused": 0})
+
+        before = [jit_stats(jit_cluster.delegate), jit_stats(d2)]
+        results = {}
+
+        def submit(name, delegate, delay):
+            time.sleep(delay)
+            results[name] = _submit(delegate,
+                                    make_jit_task(hlo, cache_control=0))
+
+        threads = [
+            threading.Thread(target=submit,
+                             args=("a", jit_cluster.delegate, 0.0)),
+            threading.Thread(target=submit, args=("b", d2, 2.5)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert results["a"] is not None and results["a"].exit_code == 0
+        assert results["b"] is not None and results["b"].exit_code == 0
+        assert bytes(results["a"].files[".xla"]) == \
+            bytes(results["b"].files[".xla"])
+        assert jit_cluster.servants[0].engine.tasks_run_ever == run0 + 1
+        after = [jit_stats(jit_cluster.delegate), jit_stats(d2)]
+        ran = sum(a["actually_run"] - b["actually_run"]
+                  for a, b in zip(after, before))
+        joined = sum(a["reused"] - b["reused"]
+                     for a, b in zip(after, before))
+        assert ran == 1, f"expected one compile, saw {ran}"
+        assert joined == 1, f"expected one join, saw {joined}"
+
+    def test_mixed_cxx_and_jit_through_one_delegate(self, jit_cluster):
+        """The two workloads interleave through the same delegate,
+        scheduler, servant and cache — and the per-kind provenance
+        counters separate them."""
+        from yadcc_tpu.daemon.local.cxx_task import CxxCompilationTask
+
+        src = b"int mixed_workload();"
+        cxx = CxxCompilationTask(
+            requestor_pid=1, source_path="/src/mix.cc",
+            source_digest=digest_bytes(src), invocation_arguments="-O2",
+            cache_control=0,
+            compiler_digest=digest_file(
+                jit_cluster.compiler_dir + "/g++"),
+            compressed_source=compress.compress(src))
+        hlo = b"module @jit_mix { func.func public @main() { return } }"
+        r_cxx = _submit(jit_cluster.delegate, cxx)
+        r_jit = _submit(jit_cluster.delegate,
+                        make_jit_task(hlo, cache_control=0))
+        assert r_cxx is not None and r_cxx.exit_code == 0
+        assert r_jit is not None and r_jit.exit_code == 0
+        by_kind = jit_cluster.delegate.inspect()["stats_by_kind"]
+        assert by_kind["cxx"]["actually_run"] >= 1
+        assert by_kind["jit"]["actually_run"] >= 1
+        # The aggregate surface stays the sum of the per-kind split.
+        agg = jit_cluster.delegate.inspect()["stats"]
+        for counter in agg:
+            assert agg[counter] == sum(
+                v[counter] for v in by_kind.values())
+
+
+# -- the HTTP protocol: submit/wait routes + the cache shim -------------------
+
+
+class TestJitHttpRoutes:
+    def test_submit_without_environment_400_then_retry(self, jit_cluster):
+        """The NeedCompilerDigest pattern for the jit workload: a
+        submission naming no environment gets a 400 telling the client
+        what to supply; the repaired submission succeeds."""
+        env = local_jit_environment("cpu")
+        hlo = b"module @jit_http { func.func public @main() { return } }"
+        req = api.jit.SubmitJitTaskRequest(
+            requestor_process_id=1,
+            computation_digest=digest_bytes(hlo),
+            backend="cpu", cache_control=1)  # jaxlib_version missing
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(hlo)])
+        status, data = post_local(jit_cluster.http.port,
+                                  "/local/submit_jit_task", body)
+        assert status == 400
+        assert b"jit environment" in data
+
+        req.jaxlib_version = env.jaxlib_version
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(hlo)])
+        status, data = post_local(jit_cluster.http.port,
+                                  "/local/submit_jit_task", body)
+        assert status == 200
+        task_id = json.loads(data)["task_id"]
+
+        # Long-poll the wait route to completion.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            wreq = api.jit.WaitForJitTaskRequest(
+                task_id=int(task_id), milliseconds_to_wait=1000)
+            status, data = post_local(
+                jit_cluster.http.port, "/local/wait_for_jit_task",
+                json_format.MessageToJson(wreq).encode())
+            if status != 503:
+                break
+        assert status == 200
+        chunks = multi_chunk.try_parse_multi_chunk(data)
+        msg = json_format.Parse(bytes(chunks[0]),
+                                api.jit.WaitForJitTaskResponse())
+        assert msg.exit_code == 0
+        assert list(msg.artifact_keys) == [".xla"]
+        assert compress.decompress(
+            bytes(chunks[1])).startswith(b"FAKEXLA1")
+
+    def test_bad_chunking_is_400(self, jit_cluster):
+        status, data = post_local(jit_cluster.http.port,
+                                  "/local/submit_jit_task", b"raw")
+        assert status == 400
+        assert b"stablehlo" in data
+
+    def test_frontend_offload_roundtrip(self, jit_cluster, monkeypatch):
+        monkeypatch.setenv("YTPU_DAEMON_PORT",
+                           str(jit_cluster.http.port))
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "1")
+        from yadcc_tpu.jit.frontend import offload_compile
+
+        hlo = b"module @jit_fe { func.func public @main() { return } }"
+        out = offload_compile(hlo)
+        assert out.ok and out.exit_code == 0
+        assert out.executable.startswith(b"FAKEXLA1")
+        # Byte-stable: resubmitting yields the identical artifact.
+        assert offload_compile(hlo).executable == out.executable
+
+    def test_frontend_disabled_and_unreachable(self, monkeypatch):
+        from yadcc_tpu.client import daemon_call
+        from yadcc_tpu.jit.frontend import offload_compile
+
+        monkeypatch.delenv("YTPU_JIT_OFFLOAD", raising=False)
+        out = offload_compile(HLO)
+        assert not out.ok and out.executable is None
+
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "1")
+        monkeypatch.setattr(
+            daemon_call, "_handler",
+            lambda method, path, body: daemon_call.DaemonResponse(-1, b""))
+        out = offload_compile(HLO)
+        assert not out.ok and out.executable is None
+
+    def test_cache_shim_round_trip(self, jit_cluster, monkeypatch):
+        monkeypatch.setenv("YTPU_DAEMON_PORT",
+                           str(jit_cluster.http.port))
+        from yadcc_tpu.jit.cache_shim import ClusterCompileCache
+
+        shim = ClusterCompileCache()
+        shim.put("jax-cache-key-1", b"locally-compiled-executable")
+        got = None
+        for _ in range(40):
+            time.sleep(0.25)
+            jit_cluster.cache_reader.sync_once()
+            got = shim.get("jax-cache-key-1")
+            if got is not None:
+                break
+        assert got == b"locally-compiled-executable"
+        assert shim.get("jax-cache-key-never-put") is None
+
+
+# -- env knobs ----------------------------------------------------------------
+
+
+class TestJitEnvKnobs:
+    def test_offload_gate_validation(self, monkeypatch):
+        from yadcc_tpu.client import env_options
+
+        monkeypatch.delenv("YTPU_JIT_OFFLOAD", raising=False)
+        assert env_options.jit_offload_enabled() is False
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "1")
+        assert env_options.jit_offload_enabled() is True
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "yes")  # unparsable: off
+        assert env_options.jit_offload_enabled() is False
+
+    def test_timeout_validation(self, monkeypatch):
+        from yadcc_tpu.client import env_options
+
+        monkeypatch.setenv("YTPU_JIT_TIMEOUT_S", "7.5")
+        assert env_options.jit_timeout_s() == 7.5
+        monkeypatch.setenv("YTPU_JIT_TIMEOUT_S", "-3")
+        assert env_options.jit_timeout_s() == 120.0
+        monkeypatch.setenv("YTPU_JIT_TIMEOUT_S", "soon")
+        assert env_options.jit_timeout_s() == 120.0
+
+    def test_local_fallback_default_on(self, monkeypatch):
+        from yadcc_tpu.client import env_options
+
+        monkeypatch.delenv("YTPU_JIT_LOCAL_FALLBACK", raising=False)
+        assert env_options.jit_local_fallback() is True
+        monkeypatch.setenv("YTPU_JIT_LOCAL_FALLBACK", "0")
+        assert env_options.jit_local_fallback() is False

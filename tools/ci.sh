@@ -62,6 +62,15 @@ if ! python -m yadcc_tpu.tools.dataplane_bench --smoke; then
   fail=1
 fi
 
+echo "== jit offload smoke =="
+# Second-workload gate: a duplicate-heavy synthetic StableHLO corpus
+# through the real loopback farm (fake worker).  Fails on any task
+# failure or if cluster-wide dedup never engaged (doc/jit_offload.md).
+if ! python -m yadcc_tpu.tools.cluster_sim --workload jit --smoke; then
+  echo "jit offload smoke FAILED" >&2
+  fail=1
+fi
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 "${YTPU_CI_TEST_TIMEOUT:-870}" \

@@ -37,6 +37,20 @@ configuration is environment variables:
                            throughput-over-ratio tune — doc/cache.md);
                            out-of-range or unparsable values fall back
                            to the default
+    YTPU_JIT_OFFLOAD       1 = offload XLA jit compilations to the
+                           cluster (default 0: the second workload is
+                           opt-in per process — doc/jit_offload.md)
+    YTPU_JIT_TIMEOUT_S     overall budget for one offloaded compile,
+                           submit through artifact (default 120;
+                           unparsable or non-positive values fall back
+                           to the default — XLA compiles are minutes at
+                           the tail, not seconds)
+    YTPU_JIT_LOCAL_FALLBACK
+                           1 (default) = compile locally when the
+                           cluster can't (no daemon, no capacity, no
+                           version-matching servant, timeout); 0 =
+                           surface the failure instead (CI rigs that
+                           must NOT mask a broken farm)
 """
 
 from __future__ import annotations
@@ -109,6 +123,34 @@ def debugging_compile_locally() -> bool:
 
 def treat_stdin_as_lightweight() -> bool:
     return _int_env("YTPU_TREAT_SOURCE_FROM_STDIN_AS_LIGHTWEIGHT", 0) == 1
+
+
+def jit_offload_enabled() -> bool:
+    """YTPU_JIT_OFFLOAD: opt-in gate for the jit workload.  Validated
+    like YTPU_COMPRESS_LEVEL: anything but a parsable 1 means off —
+    offload silently engaging on a typo would be surprising in the bad
+    direction (compiles leave the machine)."""
+    return _int_env("YTPU_JIT_OFFLOAD", 0) == 1
+
+
+def jit_timeout_s() -> float:
+    """YTPU_JIT_TIMEOUT_S: submit-to-artifact budget for one offloaded
+    compile.  Unparsable or non-positive values fall back to the
+    default rather than producing a zero/negative deadline that would
+    fail every offload instantly."""
+    default = 120.0
+    try:
+        v = float(os.environ.get("YTPU_JIT_TIMEOUT_S", default))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def jit_local_fallback() -> bool:
+    """YTPU_JIT_LOCAL_FALLBACK: compile locally on any infrastructure
+    miss (default).  Off = raise, for rigs that must not mask a broken
+    farm behind silently-local compiles."""
+    return _int_env("YTPU_JIT_LOCAL_FALLBACK", 1) == 1
 
 
 def compress_level() -> int:

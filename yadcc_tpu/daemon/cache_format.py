@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Tuple
 from ..common.hashing import new_digest
 from ..common.multi_chunk import try_parse_multi_chunk_views
 from ..common.payload import Payload
-from .task_digest import get_cxx_task_digest
+from .task_digest import get_cxx_task_digest, get_jit_task_digest
 
 _MAGIC = b"YTC2"
 _LEN = struct.Struct("<I")
@@ -34,6 +34,15 @@ _LEN = struct.Struct("<I")
 # Bump the key prefix on any format change: old entries become silent
 # misses instead of parse failures (reference cache_format.cc:56-64).
 _KEY_PREFIX = "ytpu-cxx2-entry-"
+# Second workload, own versioned namespace: a jit artifact can never be
+# read back as a C++ object file even if key derivation ever collided.
+_JIT_KEY_PREFIX = "ytpu-jit1-entry-"
+
+# Entry kinds.  "cxx" is the wire default and is OMITTED from the
+# serialized meta, so every historical entry (and the dataplane A/B
+# parity gate against the legacy writer) stays byte-identical.
+KIND_CXX = "cxx"
+KIND_JIT = "jit"
 
 
 @dataclass
@@ -47,12 +56,21 @@ class CacheEntry:
     # file key -> [(position, total_size, suffix_to_keep)].
     patches: Dict[str, List[Tuple[int, int, bytes]]] = field(
         default_factory=dict)
+    # Workload kind (KIND_*): parsers reject an entry of the wrong kind
+    # as a miss, so a task type can only ever consume its own entries.
+    kind: str = KIND_CXX
 
 
 def get_cache_key(compiler_digest: str, invocation_arguments: str,
                   source_digest: str) -> str:
     return _KEY_PREFIX + get_cxx_task_digest(
         compiler_digest, invocation_arguments, source_digest)
+
+
+def get_jit_cache_key(env_digest: str, compile_options: bytes,
+                      computation_digest: str) -> str:
+    return _JIT_KEY_PREFIX + get_jit_task_digest(
+        env_digest, compile_options, computation_digest)
 
 
 def write_cache_entry_payload(entry: CacheEntry) -> Payload:
@@ -78,6 +96,10 @@ def write_cache_entry_payload(entry: CacheEntry) -> Payload:
             for k, v in entry.patches.items()
         },
     }
+    if entry.kind != KIND_CXX:
+        # "cxx" stays implicit (see KIND_CXX note): the kind key is
+        # integrity-covered like every other meta field.
+        meta["kind"] = entry.kind
     # Digest over the serialized meta (sort_keys: canonical form) plus
     # the body, so every field is integrity-protected.
     h = new_digest()
@@ -95,8 +117,15 @@ def write_cache_entry(entry: CacheEntry) -> bytes:
     return write_cache_entry_payload(entry).join()
 
 
-def try_parse_cache_entry(data) -> Optional[CacheEntry]:
+def try_parse_cache_entry(data,
+                          expect_kind: str = KIND_CXX
+                          ) -> Optional[CacheEntry]:
     """None on any corruption — a bad entry must read as a miss.
+
+    ``expect_kind`` guards cross-workload reads: an entry of another
+    kind parses as a miss, not as garbage handed to the wrong consumer
+    (the key-prefix namespaces should already prevent this; the kind
+    check makes it a two-factor guarantee).
 
     Accepts ``bytes``, a ``memoryview`` (an RPC attachment still backed
     by its frame) or a ``Payload``; file contents come back as views
@@ -118,6 +147,8 @@ def try_parse_cache_entry(data) -> Optional[CacheEntry]:
         h.update(body)
         if claimed != h.hexdigest():
             return None  # integrity failure (meta or body tampered)
+        if meta.get("kind", KIND_CXX) != expect_kind:
+            return None  # wrong workload's entry: a miss, not data
         chunks = try_parse_multi_chunk_views(body)
         if chunks is None or len(chunks) != len(meta["file_keys"]):
             return None
@@ -130,6 +161,7 @@ def try_parse_cache_entry(data) -> Optional[CacheEntry]:
                 k: [(p, t, bytes.fromhex(s)) for p, t, s in v]
                 for k, v in meta.get("patches", {}).items()
             },
+            kind=meta.get("kind", KIND_CXX),
         )
     except Exception:
         return None

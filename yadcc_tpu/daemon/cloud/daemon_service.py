@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ... import api
+from ...jit.env import JitEnvironment, default_jit_environments
 from ...rpc import Channel, RpcContext, RpcError, ServiceSpec
 from ...utils.logging import get_logger
 from ...version import VERSION_FOR_UPGRADE
@@ -34,6 +35,7 @@ from .execution_engine import (
     ExecutionEngine,
     decide_capacity,
 )
+from .jit_task import CloudJitCompilationTask
 
 logger = get_logger("daemon.cloud.service")
 
@@ -63,11 +65,22 @@ class DaemonService:
         sampler: Optional[LoadAverageSampler] = None,
         allow_poor_machine: bool = True,
         cgroup_present: Optional[bool] = None,
+        jit_environments: Optional[List[JitEnvironment]] = None,
     ):
         self.config = config
         self.engine = engine
         self.registry = registry
         self.cache_writer = cache_writer
+        # Jit environments this servant compiles for.  None = the
+        # default (this host's cpu-backend environment when a jaxlib is
+        # importable, nothing otherwise); [] = jit serving disabled.
+        # Their digests ride heartbeat env_descs exactly like compiler
+        # digests, so the scheduler's env-matched grant pools gate jit
+        # grants to version-matching servants with no scheduler change.
+        if jit_environments is None:
+            jit_environments = default_jit_environments()
+        self._jit_envs = list(jit_environments)
+        self._jit_env_digests = {e.digest: e for e in self._jit_envs}
         self.sampler = sampler or LoadAverageSampler()
         self._allow_poor = allow_poor_machine
         self._cgroup = cgroup_present
@@ -86,6 +99,9 @@ class DaemonService:
         s.add("QueueCxxCompilationTask",
               api.daemon.QueueCxxCompilationTaskRequest,
               self.QueueCxxCompilationTask)
+        s.add("QueueJitCompilationTask",
+              api.jit.QueueJitCompilationTaskRequest,
+              self.QueueJitCompilationTask)
         s.add("ReferenceTask", api.daemon.ReferenceTaskRequest,
               self.ReferenceTask)
         s.add("WaitForCompilationOutput",
@@ -180,6 +196,80 @@ class DaemonService:
             raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
                            "servant saturated")
         return api.daemon.QueueCxxCompilationTaskResponse(task_id=task_id)
+
+    def QueueJitCompilationTask(self, req, attachment: bytes,
+                                ctx: RpcContext):
+        """Second-workload twin of QueueCxxCompilationTask: an XLA jit
+        compile lands on the same engine (admission, refcounts,
+        kill-on-lease-expiry) through the same generic wait/free RPC
+        surface; only submission is jit-specific."""
+        self._verify(req.token)
+        if req.compression_algorithm != \
+                api.daemon.COMPRESSION_ALGORITHM_ZSTD:
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                           "only zstd computations accepted")
+        # Version gating: grants should only land here for digests we
+        # advertised, but a direct (or stale-grant) submission for an
+        # XLA stack we don't serve must be refused, not compiled into
+        # an artifact the requestor cannot deserialize.
+        env = self._jit_env_digests.get(req.env_desc.compiler_digest)
+        if env is None:
+            raise RpcError(
+                api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE,
+                req.env_desc.compiler_digest)
+        task = CloudJitCompilationTask(
+            env_digest=env.digest,
+            backend=req.backend or env.backend,
+            compile_options=req.compile_options,
+            claimed_computation_digest=req.computation_digest,
+            temp_root=self.config.temporary_dir,
+            disallow_cache_fill=req.disallow_cache_fill,
+        )
+        try:
+            task.prepare(attachment)
+        except ValueError as e:
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT, str(e))
+
+        # Defensive dedup, same as cxx: the delegate-side join usually
+        # catches duplicate compilations first, but N delegates racing
+        # the same cold model step can all be granted before any of
+        # them shows up in the running-task snapshot.
+        existing = self.engine.find_task_by_digest(task.task_digest)
+        if existing is not None and self.engine.reference_task(existing):
+            task.workspace.remove()
+            return api.jit.QueueJitCompilationTaskResponse(
+                task_id=existing)
+
+        def on_completion(task_id: int, output):
+            files, patches, cache_entry = task.collect_outputs(output)
+            result = _TaskResult(
+                exit_code=output.exit_code,
+                standard_output=output.standard_output,
+                standard_error=output.standard_error,
+                files=files,
+                patches=patches,
+            )
+            with self._lock:
+                self._results[task_id] = result
+            if cache_entry is not None and self.cache_writer is not None:
+                self.cache_writer.async_write(task.cache_key, cache_entry)
+
+        task_id = self.engine.try_queue_task(
+            grant_id=req.task_grant_id,
+            digest=task.task_digest,
+            cmdline=task.cmdline,
+            on_completion=on_completion,
+            # The worker needs the package importable from the engine's
+            # `sh -c` launch; serialized executables embed no paths, so
+            # no padded workspace (see cloud/jit_task.py).
+            env=task.worker_env(),
+            cwd=task.workspace.path,
+        )
+        if task_id is None:
+            task.workspace.remove()
+            raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
+                           "servant saturated")
+        return api.jit.QueueJitCompilationTaskResponse(task_id=task_id)
 
     def ReferenceTask(self, req, attachment, ctx):
         self._verify(req.token)
@@ -290,6 +380,11 @@ class DaemonService:
         )
         for digest in self.registry.environments():
             req.env_descs.add(compiler_digest=digest)
+        # Jit environments travel in the same env_desc list: to the
+        # scheduler an environment is an opaque digest, so version-
+        # matched jit grant pools come for free.
+        for env in self._jit_envs:
+            req.env_descs.add(compiler_digest=env.digest)
         for tid, grant_id, digest in self.engine.running_tasks():
             req.running_tasks.add(
                 servant_task_id=tid, task_grant_id=grant_id,
@@ -317,6 +412,11 @@ class DaemonService:
         return {
             "engine": self.engine.inspect(),
             "compilers": self.registry.environments(),
+            "jit_environments": [
+                {"backend": e.backend, "jaxlib_version": e.jaxlib_version,
+                 "digest": e.digest}
+                for e in self._jit_envs
+            ],
             "load": self.sampler.loadavg(
                 self.config.cpu_load_average_seconds),
             "load_window_s": self.config.cpu_load_average_seconds,

@@ -1,0 +1,169 @@
+"""Servant-side XLA jit-compilation task (ExecutionTask analogue).
+
+The jit twin of CloudCxxCompilationTask: prepare decompresses and
+digests the attached StableHLO (fused single pass, same as the C++
+source intake), verifies the client's claimed computation digest (a
+corrupted or forged attachment must fail fast, not poison the cache
+under the claimed key), and stages a request file for the compile
+worker; completion reads the worker's artifact, compresses it, and
+packs a kind="jit" cache entry through the shared zero-copy payload
+path.
+
+The compile itself is ``python -m yadcc_tpu.jit.compile_worker`` in its
+own process group via the SAME execution engine that runs compilers —
+admission control, reference counting, kill-on-lease-expiry and
+completed-task GC all come for free.  No path patching: serialized
+executables don't embed the workspace path, so the padded-workspace
+machinery is unnecessary here (the workspace exists only as the
+request/artifact staging area and dies with the task).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import sys
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from ...common import compress
+from ...common.multi_chunk import make_multi_chunk
+from ...common.payload import Payload
+from .. import cache_format
+from ..cache_format import CacheEntry, get_jit_cache_key
+from ..task_digest import get_jit_task_digest
+from .cxx_task import _PACK_EXECUTOR
+from .execution_engine import TaskOutput
+from .temporary import TemporaryDir
+
+# The one artifact key a jit task produces (the serialized executable);
+# a future multi-artifact compile (e.g. dumped HLO for diagnostics)
+# adds keys without a format change.
+ARTIFACT_KEY = ".xla"
+
+# Default address-space ceiling for the compile worker.  XLA on big
+# modules can balloon; a runaway compile must die inside its own
+# process, not take the servant down.  Override (or disable with 0) via
+# YTPU_JIT_WORKER_MEM_BYTES on the servant.
+_DEFAULT_WORKER_MEM_BYTES = 8 << 30
+
+
+def _worker_mem_bytes() -> int:
+    try:
+        return int(os.environ.get("YTPU_JIT_WORKER_MEM_BYTES",
+                                  _DEFAULT_WORKER_MEM_BYTES))
+    except ValueError:
+        return _DEFAULT_WORKER_MEM_BYTES
+
+
+def _fake_worker() -> bool:
+    """YTPU_JIT_FAKE_WORKER=1: deterministic pseudo-compiles (cluster
+    simulator / CI smoke — exercise the farm, not XLA)."""
+    return os.environ.get("YTPU_JIT_FAKE_WORKER", "0") == "1"
+
+
+@dataclass
+class CloudJitCompilationTask:
+    env_digest: str
+    backend: str
+    compile_options: bytes
+    claimed_computation_digest: str
+    temp_root: str
+    disallow_cache_fill: bool = False
+
+    computation_digest: str = ""
+    workspace: Optional[TemporaryDir] = None
+    cmdline: str = ""
+
+    # -- prepare -------------------------------------------------------------
+
+    def prepare(self, compressed_computation: bytes) -> None:
+        try:
+            computation, self.computation_digest = \
+                compress.decompress_and_digest(compressed_computation)
+        except (compress.CompressionError, MemoryError, ValueError):
+            raise ValueError("StableHLO attachment is not valid zstd")
+        if self.claimed_computation_digest and \
+                self.computation_digest != self.claimed_computation_digest:
+            raise ValueError("computation digest mismatch")
+
+        self.workspace = TemporaryDir(self.temp_root, "jit_")
+        options = {
+            "backend": self.backend,
+            "compile_options_hex": bytes(self.compile_options).hex(),
+            "mem_limit_bytes": _worker_mem_bytes(),
+        }
+        with open(f"{self.workspace.path}/request.bin", "wb") as fp:
+            fp.write(make_multi_chunk(
+                [json.dumps(options, sort_keys=True).encode(),
+                 computation]))
+        fake = " --fake" if _fake_worker() else ""
+        self.cmdline = (
+            f"{shlex.quote(sys.executable)} -m "
+            f"yadcc_tpu.jit.compile_worker "
+            f"--workspace {shlex.quote(self.workspace.path)}{fake}"
+        )
+
+    def worker_env(self) -> dict:
+        """Environment for the compile subprocess: the daemon's own,
+        plus the package root on PYTHONPATH (the engine launches via
+        ``sh -c`` from the workspace, where bare ``-m yadcc_tpu...``
+        would not resolve)."""
+        # __file__ is <root>/yadcc_tpu/daemon/cloud/jit_task.py; the
+        # importable root is <root>, the PARENT of the package dir.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env = dict(os.environ)
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = pkg_root + (os.pathsep + existing
+                                        if existing else "")
+        return env
+
+    @property
+    def task_digest(self) -> str:
+        return get_jit_task_digest(self.env_digest, self.compile_options,
+                                   self.computation_digest)
+
+    @property
+    def cache_key(self) -> str:
+        return get_jit_cache_key(self.env_digest, self.compile_options,
+                                 self.computation_digest)
+
+    # -- completion ----------------------------------------------------------
+
+    def collect_outputs(self, output: TaskOutput) -> Tuple[
+        Dict[str, bytes],
+        Dict[str, list],
+        Optional[Payload],
+    ]:
+        """(compressed artifacts by key, empty patches, cache-entry
+        payload or None).  Cleans up the workspace — including the
+        killed-mid-compile case, where the engine's waiter still fires
+        this callback with the SIGKILL exit code and the workspace must
+        not leak."""
+        assert self.workspace is not None
+        files: Dict[str, bytes] = {}
+        artifact = None
+        if output.exit_code == 0:
+            try:
+                with open(f"{self.workspace.path}/artifact.bin",
+                          "rb") as fp:
+                    artifact = fp.read()
+            except OSError:
+                artifact = None
+        entry_future = None
+        if artifact is not None:
+            files[ARTIFACT_KEY] = compress.compress(artifact)
+            if not self.disallow_cache_fill:
+                entry_future = _PACK_EXECUTOR.get().submit(
+                    cache_format.write_cache_entry_payload, CacheEntry(
+                        exit_code=output.exit_code,
+                        standard_output=output.standard_output,
+                        standard_error=output.standard_error,
+                        files=files,
+                        kind=cache_format.KIND_JIT,
+                    ))
+        self.workspace.remove()
+        return files, {}, (entry_future.result()
+                           if entry_future is not None else None)

@@ -68,6 +68,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    "<bundle>/*/bin is scanned (reference "
                    "--extra_compiler_bundle_dirs)")
     p.add_argument("--temporary-dir", default="")
+    p.add_argument("--jit-backends", default="auto",
+                   help="comma-separated XLA backends this servant "
+                        "compiles jit tasks for ('cpu,tpu'); 'auto' = "
+                        "cpu iff jaxlib is importable; 'none' disables "
+                        "jit serving (doc/jit_offload.md)")
     p.add_argument("--allow-poor-machine", action="store_true",
                    help="serve even with <=16 cores (small test rigs)")
     p.add_argument("--ignore-cgroup-limits", action="store_true",
@@ -173,10 +178,19 @@ def daemon_start(args) -> None:
     # rotating serving-daemon token, which the cache server never sees.
     cache_writer = DistributedCacheWriter(
         args.cache_server_uri, lambda: args.token)
+    if args.jit_backends == "auto":
+        jit_envs = None  # DaemonService default: cpu iff jaxlib imports
+    elif args.jit_backends in ("", "none"):
+        jit_envs = []
+    else:
+        from ..jit.env import local_jit_environment
+
+        jit_envs = [local_jit_environment(b)
+                    for b in args.jit_backends.split(",") if b]
     service = DaemonService(
         config, engine=engine, registry=registry, cache_writer=cache_writer,
         sampler=sampler, allow_poor_machine=args.allow_poor_machine,
-        cgroup_present=cgroup_present)
+        cgroup_present=cgroup_present, jit_environments=jit_envs)
     servant_server.add_service(service.spec())
     servant_server.start()
 
@@ -198,7 +212,11 @@ def daemon_start(args) -> None:
     stop = threading.Event()
     http = LocalHttpService(
         monitor=monitor, digest_cache=digest_cache, dispatcher=dispatcher,
-        on_leave=stop.set, port=args.local_port)
+        on_leave=stop.set, port=args.local_port,
+        # The jit persistent-compile-cache shim routes: gets through the
+        # delegate's Bloom-replicated reader, puts through the servant
+        # role's writer (static token, same as compile-output fills).
+        cache_reader=cache_reader, cache_writer=cache_writer)
 
     config_keeper.start()
     cache_reader.start()

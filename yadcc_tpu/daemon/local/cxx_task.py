@@ -42,6 +42,8 @@ class CxxCompilationTask(DistributedTask):
     compressed_source: bytes
     ignore_timestamp_macros: bool = False
 
+    kind = "cxx"
+
     def get_cache_setting(self) -> int:
         if self.cache_control in (self.CACHE_DISALLOW, self.CACHE_ALLOW,
                                   self.CACHE_REFILL):

@@ -29,10 +29,15 @@ class TaskResult:
 
 
 class DistributedTask:
-    """SPI; implementations: CxxCompilationTask (more languages later).
+    """SPI; implementations: CxxCompilationTask, JitCompilationTask
+    (more workloads ride the same seam — see
+    daemon/local/task_registry.py for how a new kind is wired in).
 
     Implementations must expose `requestor_pid` (0 = unknown) for the
-    dispatcher's orphan-kill timer."""
+    dispatcher's orphan-kill timer, and a class-level `kind` string
+    (stable, lowercase) used for per-workload stats and diagnostics."""
+
+    kind = "unknown"
 
     # Cache policy (reference distributed_task.h:36 CacheControl):
     CACHE_DISALLOW = 0  # never read, never fill

@@ -1,4 +1,10 @@
-"""Delegate-side task dispatcher: one state machine per in-flight TU.
+"""Delegate-side task dispatcher: one state machine per in-flight task.
+
+Workload-agnostic: everything task-specific (cache key, dedup digest,
+servant submission, output parsing) lives behind the DistributedTask
+SPI, and the servant's wait/reference/free RPC surface is shared by all
+task kinds — so C++ TUs and XLA jit compilations run through this same
+machine, interleaved, with per-kind provenance counters.
 
 Parity with reference yadcc/daemon/local/distributed_task_dispatcher
 .{h,cc}: a queued task runs Pending -> ReadyToFire -> Dispatched -> Done
@@ -86,6 +92,10 @@ class DistributedTaskDispatcher:
         self._channels: Dict[str, Channel] = {}  # guarded by: self._lock
         self.stats = {"hit_cache": 0, "reused": 0, "actually_run": 0,
                       "failed": 0}  # guarded by: self._lock
+        # Same counters split per task kind ("cxx"/"jit"/...): the
+        # aggregate above is the long-standing public surface, the
+        # split is what a mixed-workload deployment actually watches.
+        self.stats_by_kind: Dict[str, Dict[str, int]] = {}  # guarded by: self._lock
 
     # -- public API ----------------------------------------------------------
 
@@ -106,9 +116,16 @@ class DistributedTaskDispatcher:
             self._tasks[entry.task_id] = entry
         threading.Thread(
             target=self._perform_one_task, args=(entry,),
-            name=f"tu-{entry.task_id}", daemon=True,
+            name=f"{task.kind}-{entry.task_id}", daemon=True,
         ).start()
         return entry.task_id
+
+    def _bump_locked(self, kind: str, counter: str) -> None:
+        """Increment a provenance counter; caller holds self._lock."""
+        self.stats[counter] += 1
+        per = self.stats_by_kind.setdefault(
+            kind, {k: 0 for k in self.stats})
+        per[counter] += 1
 
     def wait_for_task(self, task_id: int,
                       timeout_s: float) -> Optional[TaskResult]:
@@ -145,7 +162,7 @@ class DistributedTaskDispatcher:
             # in-flight task, and dict `+=` is a read-modify-write that
             # loses increments when two of them interleave.
             with self._lock:
-                self.stats["failed"] += 1
+                self._bump_locked(entry.task.kind, "failed")
         with self._lock:
             entry.result = result
             entry.state = TaskState.DONE
@@ -172,7 +189,7 @@ class DistributedTaskDispatcher:
             logger.warning("corrupted cache entry for %s", key)
             return None
         with self._lock:
-            self.stats["hit_cache"] += 1
+            self._bump_locked(entry.task.kind, "hit_cache")
         return result
 
     def _try_join_existing(self, entry: _Entry) -> Optional[TaskResult]:
@@ -203,7 +220,7 @@ class DistributedTaskDispatcher:
         self._free_servant_task(entry, token)
         if result is not None:
             with self._lock:
-                self.stats["reused"] += 1
+                self._bump_locked(entry.task.kind, "reused")
         return result
 
     def _start_new_servant_task(self, entry: _Entry) -> TaskResult:
@@ -236,7 +253,7 @@ class DistributedTaskDispatcher:
                 standard_error=b"servant lost while compiling")
         else:
             with self._lock:
-                self.stats["actually_run"] += 1
+                self._bump_locked(entry.task.kind, "actually_run")
         return result
 
     def _wait_servant(self, entry: _Entry,
@@ -328,6 +345,8 @@ class DistributedTaskDispatcher:
                 "retained": sum(1 for e in self._tasks.values()
                                 if e.state == TaskState.DONE),
                 "stats": dict(self.stats),
+                "stats_by_kind": {k: dict(v) for k, v
+                                  in self.stats_by_kind.items()},
             }
 
 

@@ -10,9 +10,18 @@ multi-chunk framing when attachments are present.  Routes:
     POST /local/acquire_quota        (200 granted / 503 timeout)
     POST /local/release_quota
     POST /local/set_file_digest
-    POST /local/submit_cxx_task      (multi-chunk: json + zstd source;
-                                      400: report compiler digest first)
-    POST /local/wait_for_cxx_task    (503: still running, retry;
+    POST /local/jit_cache_get        (persistent-compile-cache shim;
+                                      404: miss)
+    POST /local/jit_cache_put
+
+plus one submit/wait route PAIR per registered task kind
+(task_registry.py — cxx and jit today):
+
+    POST /local/submit_<kind>_task   (multi-chunk: json + attachment;
+                                      400: fix the submission and retry
+                                      — e.g. report compiler digest /
+                                      jit environment first)
+    POST /local/wait_for_<kind>_task (503: still running, retry;
                                       404: unknown task id)
 """
 
@@ -26,15 +35,27 @@ from google.protobuf import json_format
 
 from ... import api
 from ...common import multi_chunk
+from ...common.hashing import digest_keyed
 from ...common.payload import Payload
 from ...utils.logging import get_logger
 from ...version import BUILT_AT, VERSION_FOR_UPGRADE
-from .cxx_task import NeedCompilerDigest, make_cxx_task
 from .distributed_task_dispatcher import DistributedTaskDispatcher
 from .file_digest_cache import FileDigestCache
 from .local_task_monitor import LocalTaskMonitor
+from .task_registry import TaskTypeRegistry, default_registry
 
 logger = get_logger("daemon.http")
+
+# Shim keys are opaque client-side strings (jax's own cache hashes);
+# domain-hash them into a versioned namespace so they can never collide
+# with task-derived cache keys.
+_SHIM_KEY_PREFIX = "ytpu-jitext1-"
+_SHIM_KEY_DOMAIN = "ytpu-jit-extcache"
+
+
+def shim_cache_key(client_key: str) -> str:
+    return _SHIM_KEY_PREFIX + digest_keyed(_SHIM_KEY_DOMAIN,
+                                           client_key.encode())
 
 
 def _to_json(msg) -> bytes:
@@ -61,11 +82,21 @@ class LocalHttpService:
         on_leave: Optional[Callable[[], None]] = None,
         port: int = 8334,
         host: str = "127.0.0.1",
+        registry: Optional[TaskTypeRegistry] = None,
+        # Shim routes: reads go through the delegate's Bloom-replicated
+        # reader, puts through the servant role's cache writer (the one
+        # process runs both roles — daemon/entry.py).  Either absent =>
+        # the corresponding route answers 404.
+        cache_reader=None,
+        cache_writer=None,
     ):
         self.monitor = monitor
         self.digest_cache = digest_cache
         self.dispatcher = dispatcher
         self.on_leave = on_leave or (lambda: None)
+        self.registry = registry or default_registry(digest_cache)
+        self.cache_reader = cache_reader
+        self.cache_writer = cache_writer
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -152,50 +183,88 @@ class LocalHttpService:
                                   req.file_desc.timestamp, req.digest)
             handler._reply(200, _to_json(api.local.SetFileDigestResponse()))
             return
-        if path == "/local/submit_cxx_task":
-            # Views: the (possibly multi-MB) source chunk stays a view
-            # into the request body all the way to the servant RPC.
-            chunks = multi_chunk.try_parse_multi_chunk_views(body)
-            if not chunks or len(chunks) != 2:
-                handler._reply(400, b'{"error":"expect json+source chunks"}')
-                return
-            req = _from_json(api.local.SubmitCxxTaskRequest, bytes(chunks[0]))
-            try:
-                task = make_cxx_task(req, chunks[1], self.digest_cache)
-            except NeedCompilerDigest:
-                handler._reply(
-                    400, b'{"error":"compiler digest unknown; '
-                         b'set_file_digest first"}')
-                return
-            task_id = self.dispatcher.queue_task(task)
-            handler._reply(200, _to_json(
-                api.local.SubmitCxxTaskResponse(task_id=task_id)))
+        if path == "/local/jit_cache_get":
+            self._jit_cache_get(handler, body)
             return
-        if path == "/local/wait_for_cxx_task":
-            req = _from_json(api.local.WaitForCxxTaskRequest, body)
-            result = self.dispatcher.wait_for_task(
-                req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
-            if result is None:
-                handler._reply(
-                    404 if not self.dispatcher.is_known(req.task_id) else 503)
-                return
-            resp = api.local.WaitForCxxTaskResponse(
-                exit_code=result.exit_code,
-                output=result.standard_output.decode(errors="replace"),
-                error=result.standard_error.decode(errors="replace"),
-            )
-            file_keys = sorted(result.files)
-            chunks = [b""]  # placeholder for json
-            for key in file_keys:
-                resp.file_extensions.append(key)
-                pl = resp.patches.add(file_key=key)
-                for pos, total, suffix in result.patches.get(key, []):
-                    pl.locations.add(position=pos, total_size=total,
-                                     suffix_to_keep=suffix)
-                chunks.append(result.files[key])
-            chunks[0] = _to_json(resp)
-            self.dispatcher.free_task(req.task_id)
-            handler._reply(200, multi_chunk.make_multi_chunk_payload(chunks),
-                           content_type="application/octet-stream")
+        if path == "/local/jit_cache_put":
+            self._jit_cache_put(handler, body)
+            return
+        task_type = self.registry.for_submit(path)
+        if task_type is not None:
+            self._submit_task(handler, task_type, body)
+            return
+        task_type = self.registry.for_wait(path)
+        if task_type is not None:
+            self._wait_for_task(handler, task_type, body)
             return
         handler._reply(404)
+
+    # -- generic task submit/wait (one flow for every registered kind) -------
+
+    def _submit_task(self, handler, task_type, body: bytes) -> None:
+        # Views: the (possibly multi-MB) attachment chunk stays a view
+        # into the request body all the way to the servant RPC.
+        chunks = multi_chunk.try_parse_multi_chunk_views(body)
+        if not chunks or len(chunks) != 2:
+            handler._reply(400, task_type.bad_chunks_error)
+            return
+        req = _from_json(task_type.submit_request_cls, bytes(chunks[0]))
+        try:
+            task = task_type.make_task(req, chunks[1])
+        except Exception as e:
+            err = task_type.submit_error(e)
+            if err is None:
+                raise
+            handler._reply(400, err)
+            return
+        task_id = self.dispatcher.queue_task(task)
+        # Every submit response is {task_id}; the registered response
+        # classes share the field by convention.
+        handler._reply(200, _to_json(
+            api.local.SubmitCxxTaskResponse(task_id=task_id)))
+
+    def _wait_for_task(self, handler, task_type, body: bytes) -> None:
+        req = _from_json(task_type.wait_request_cls, body)
+        result = self.dispatcher.wait_for_task(
+            req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
+        if result is None:
+            handler._reply(
+                404 if not self.dispatcher.is_known(req.task_id) else 503)
+            return
+        resp, out_chunks = task_type.build_wait_response(result)
+        self.dispatcher.free_task(req.task_id)
+        handler._reply(
+            200,
+            multi_chunk.make_multi_chunk_payload(
+                [_to_json(resp)] + list(out_chunks)),
+            content_type="application/octet-stream")
+
+    # -- persistent-compile-cache shim routes --------------------------------
+
+    def _jit_cache_get(self, handler, body: bytes) -> None:
+        req = _from_json(api.jit.JitCacheGetRequest, body)
+        if self.cache_reader is None or not req.key:
+            handler._reply(404)
+            return
+        data = self.cache_reader.try_read(shim_cache_key(req.key))
+        if data is None:
+            handler._reply(404)
+            return
+        handler._reply(
+            200,
+            multi_chunk.make_multi_chunk_payload(
+                [_to_json(api.jit.JitCacheGetResponse()), data]),
+            content_type="application/octet-stream")
+
+    def _jit_cache_put(self, handler, body: bytes) -> None:
+        chunks = multi_chunk.try_parse_multi_chunk_views(body)
+        if not chunks or len(chunks) != 2:
+            handler._reply(400, b'{"error":"expect json+value chunks"}')
+            return
+        req = _from_json(api.jit.JitCachePutRequest, bytes(chunks[0]))
+        if self.cache_writer is None or not req.key:
+            handler._reply(404)
+            return
+        self.cache_writer.async_write(shim_cache_key(req.key),
+                                      bytes(chunks[1]))
+        handler._reply(200, _to_json(api.jit.JitCachePutResponse()))

@@ -12,6 +12,7 @@ from __future__ import annotations
 from ..common.hashing import digest_keyed
 
 _DOMAIN = "ytpu-cxx-task"
+_JIT_DOMAIN = "ytpu-jit-task"
 
 
 def get_cxx_task_digest(compiler_digest: str, invocation_arguments: str,
@@ -21,4 +22,19 @@ def get_cxx_task_digest(compiler_digest: str, invocation_arguments: str,
         compiler_digest.encode(),
         invocation_arguments.encode(),
         source_digest.encode(),
+    )
+
+
+def get_jit_task_digest(env_digest: str, compile_options: bytes,
+                        computation_digest: str) -> str:
+    """Jit analogue of the (compiler, args, source) triple:
+    (jit environment, serialized CompileOptions, lowered StableHLO) —
+    each the full determinant of the compile's output in its slot.
+    Separate domain: a jit task digest can never collide with a cxx one
+    even on crafted inputs."""
+    return digest_keyed(
+        _JIT_DOMAIN,
+        env_digest.encode(),
+        bytes(compile_options),
+        computation_digest.encode(),
     )

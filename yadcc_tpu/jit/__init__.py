@@ -1,0 +1,44 @@
+"""XLA jit-compilation offload: the second DistributedTask workload.
+
+The reference is a compile farm for exactly one task type (C++ TUs) but
+ships a language-extensible SPI ("more languages later",
+yadcc/daemon/local/distributed_task.h).  This package opens that seam
+for the TPU-native workload that dominates JAX cold start: XLA
+compilation of lowered computations.  Same shape as a TU — a
+deterministic, expensive function of hashable inputs, massively
+duplicated across a fleet — so the whole stack applies unchanged:
+Bloom-filtered distributed cache, cluster-wide dedup of in-flight
+compilations (N hosts jitting the same model step compile it once),
+leased grants, version-matched environments.
+
+Layers (doc/jit_offload.md):
+
+* ``env.py``       — jit environment descriptors (backend + jaxlib
+                     version digest; the EnvironmentDesc of this
+                     workload).
+* ``frontend.py``  — client side: digest a lowered computation into a
+                     cache key, submit over the daemon's loopback HTTP
+                     protocol, wait, local fallback.
+* ``cache_shim.py``— JAX persistent-compilation-cache-style get/put
+                     over the cluster cache, for programs that want
+                     cache *sharing* without compile *offload*.
+* ``compile_worker.py`` — the servant's sandboxed compile subprocess.
+
+Delegate/servant task implementations live with their peers in
+``yadcc_tpu/daemon/local/jit_task.py`` / ``yadcc_tpu/daemon/cloud/
+jit_task.py``.
+"""
+
+from .env import (
+    JitEnvironment,
+    default_jit_environments,
+    jit_env_digest,
+    local_jit_environment,
+)
+
+__all__ = [
+    "JitEnvironment",
+    "default_jit_environments",
+    "jit_env_digest",
+    "local_jit_environment",
+]

@@ -1,0 +1,80 @@
+"""JAX persistent-compilation-cache-style shim over the cluster cache.
+
+Two ways to consume the jit subsystem: full offload (frontend.py — the
+COMPILE runs remotely) and this shim — the compile still runs locally,
+but the resulting executable is shared cluster-wide through the same
+two-level distributed cache the compile farm uses.  That is exactly the
+shape of jax's persistent compilation cache (a get/put key-value store
+keyed by jax's own computation hash), so a program can point that
+machinery at the local daemon and every host in the fleet warms every
+other host's cold start.
+
+Keys are opaque client-namespace strings; the daemon domain-hashes them
+into a versioned ``ytpu-jitext1-`` namespace (http_service.py
+``shim_cache_key``), so shim entries can never collide with task-derived
+cache entries, and a jax cache-key format change is just a new prefix.
+
+Wire shape (multi-chunk [json, value] both directions, like every other
+attachment-bearing local route):
+
+    POST /local/jit_cache_get   200 [json, value] | 404 miss
+    POST /local/jit_cache_put   200 | 404 shim disabled on this daemon
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from google.protobuf import json_format
+
+from .. import api
+from ..client.daemon_call import call_daemon
+from ..common import multi_chunk
+from ..utils.logging import get_logger
+
+logger = get_logger("jit.cache_shim")
+
+
+class ClusterCompileCache:
+    """get/put facade matching jax.experimental.compilation_cache's
+    CacheInterface surface (get returns None on miss)."""
+
+    def get(self, key: str) -> Optional[bytes]:
+        req = api.jit.JitCacheGetRequest(key=key)
+        resp = call_daemon("POST", "/local/jit_cache_get",
+                           json_format.MessageToJson(req).encode())
+        if resp.status != 200:
+            return None
+        chunks = multi_chunk.try_parse_multi_chunk(resp.body)
+        if not chunks or len(chunks) != 2:
+            logger.warning("malformed jit_cache_get reply for %r", key)
+            return None
+        return bytes(chunks[1])
+
+    def put(self, key: str, value: bytes) -> None:
+        req = api.jit.JitCachePutRequest(key=key)
+        body = multi_chunk.make_multi_chunk_payload([
+            json_format.MessageToJson(req).encode(), value])
+        resp = call_daemon("POST", "/local/jit_cache_put", body)
+        if resp.status != 200:
+            # Fire-and-forget, like the servant's own cache fills: a
+            # missing daemon must not fail the caller's compile.
+            logger.debug("jit_cache_put %r -> HTTP %d", key, resp.status)
+
+
+def install_into_jax() -> bool:
+    """Best effort: point jax's persistent compilation cache at the
+    cluster.  The internal seam has moved across jax versions, so this
+    probes the known shapes and reports success; callers for whom the
+    shim is load-bearing should check the return value."""
+    shim = ClusterCompileCache()
+    try:
+        from jax.experimental.compilation_cache import compilation_cache \
+            as cc
+
+        if hasattr(cc, "_cache"):  # jax 0.4.x internal singleton
+            cc._cache = shim
+            return True
+    except Exception as e:
+        logger.debug("jax compilation cache seam unavailable: %r", e)
+    return False

@@ -1,0 +1,153 @@
+"""Sandboxed XLA compile subprocess.
+
+The servant's jit analogue of running the compiler binary: one process
+per compilation, launched by the execution engine in its own process
+group (so lease expiry / FreeTask SIGKILLs the whole compile, XLA
+threads included), with an optional address-space ceiling so a
+pathological computation cannot OOM the servant box.
+
+Protocol (filesystem, inside the task's padded workspace):
+
+    <ws>/request.bin   multi-chunk [options-JSON, raw StableHLO bytes]
+    <ws>/artifact.bin  serialized executable (written on success)
+
+options-JSON:  {"backend": "cpu",
+                "compile_options_hex": "<CompileOptions proto hex>",
+                "mem_limit_bytes": 0}
+
+Exit codes: 0 success, 1 compile/setup failure (diagnostics on stderr).
+``--fake`` skips XLA entirely and writes a deterministic pseudo-artifact
+derived from the request digest — the control-plane twin used by the
+cluster simulator and throughput smoke, where thousands of real XLA
+invocations would measure the compiler, not the farm.
+
+jax is imported AFTER the rlimit and JAX_PLATFORMS are set: the limit
+must cover XLA's own allocations, and the worker must initialize only
+the backend it was asked for (a TPU-attached servant compiling a
+cpu-backend artifact must not grab the TPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _read_request(workspace: str):
+    from ..common.multi_chunk import try_parse_multi_chunk
+
+    with open(os.path.join(workspace, "request.bin"), "rb") as fp:
+        chunks = try_parse_multi_chunk(fp.read())
+    if chunks is None or len(chunks) != 2:
+        raise ValueError("malformed request.bin")
+    return json.loads(chunks[0]), chunks[1]
+
+
+def _apply_mem_limit(limit_bytes: int) -> None:
+    if limit_bytes <= 0:
+        return
+    try:
+        import resource
+
+        resource.setrlimit(resource.RLIMIT_AS, (limit_bytes, limit_bytes))
+    except (ImportError, ValueError, OSError) as e:
+        print(f"warning: cannot apply memory limit: {e}", file=sys.stderr)
+
+
+def _fake_sleep() -> None:
+    """YTPU_JIT_FAKE_SLEEP_S: make fake compiles take this long, so
+    rigs can hold a compile in flight (join-path and lease-expiry
+    tests, simulator contention)."""
+    import time
+
+    try:
+        delay = float(os.environ.get("YTPU_JIT_FAKE_SLEEP_S", "0"))
+    except ValueError:
+        delay = 0.0
+    if delay > 0:
+        time.sleep(delay)
+
+
+def _fake_artifact(options: dict, computation: bytes) -> bytes:
+    """Deterministic stand-in artifact: digest-derived, content-unique
+    per (options, computation) so cache/dedup tests remain honest."""
+    from ..common.hashing import digest_keyed
+
+    d = digest_keyed("ytpu-jit-fake-artifact",
+                     json.dumps(options, sort_keys=True).encode(),
+                     computation)
+    return b"FAKEXLA1" + d.encode()
+
+
+def _compile(options: dict, computation: bytes) -> bytes:
+    import jax
+    from jax.lib import xla_client as xc
+
+    backend_name = options.get("backend", "cpu")
+    client = None
+    for dev in jax.devices():
+        if dev.client.platform == backend_name:
+            client = dev.client
+            break
+    if client is None:
+        raise RuntimeError(
+            f"backend {backend_name!r} not available in worker "
+            f"(have: {sorted({d.client.platform for d in jax.devices()})})")
+    copts = xc.CompileOptions()
+    blob = bytes.fromhex(options.get("compile_options_hex", ""))
+    if blob:
+        copts = xc.CompileOptions.ParseFromString(blob)
+    # StableHLO travels as text (Lowered.as_text()) or MLIR bytecode;
+    # the XLA client accepts both forms through the same entry point.
+    module = computation.decode() if _looks_textual(computation) \
+        else computation
+    executable = client.compile(module, copts)
+    return client.serialize_executable(executable)
+
+
+def _looks_textual(data: bytes) -> bool:
+    # MLIR bytecode starts with the magic 'ML\xef\x52'; anything else we
+    # treat as textual StableHLO.
+    return not data.startswith(b"ML\xef\x52")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("ytpu-jit-compile-worker")
+    ap.add_argument("--workspace", required=True)
+    ap.add_argument("--fake", action="store_true",
+                    help="deterministic pseudo-compile (simulator mode)")
+    args = ap.parse_args()
+    try:
+        options, computation = _read_request(args.workspace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bad request: {e}", file=sys.stderr)
+        return 1
+    _apply_mem_limit(int(options.get("mem_limit_bytes", 0)))
+    os.environ["JAX_PLATFORMS"] = options.get("backend", "cpu")
+    try:
+        if args.fake:
+            _fake_sleep()
+            artifact = _fake_artifact(options, computation)
+        else:
+            artifact = _compile(options, computation)
+    except MemoryError:
+        print("compile exceeded the worker memory limit", file=sys.stderr)
+        return 1
+    except Exception as e:
+        print(f"compile failed: {e!r}", file=sys.stderr)
+        return 1
+    tmp = os.path.join(args.workspace, "artifact.bin.part")
+    with open(tmp, "wb") as fp:
+        fp.write(artifact)
+    # Atomic publish: a killed worker can never leave a half-written
+    # artifact where the servant would pick it up.
+    os.replace(tmp, os.path.join(args.workspace, "artifact.bin"))
+    print(f"compiled {len(computation)} bytes of StableHLO into "
+          f"{len(artifact)} artifact bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+"""Jit environment descriptors.
+
+A C++ environment is the compiler binary's content digest (env_desc
+.proto): two machines expose "the same environment" iff the binaries
+are bit-identical.  The jit analogue can't hash a single binary — what
+must match for a serialized XLA executable to deserialize on another
+machine is the (backend platform, jaxlib version) pair — so the jit
+environment digest is a domain-separated hash of exactly those two
+strings.  Anything looser (major-version matching) risks artifacts
+that deserialize into subtly wrong executables; anything stricter
+(hashing the whole jaxlib wheel) would split fleets that interoperate
+fine.
+
+The digest travels wherever compiler digests travel: servant heartbeat
+``env_descs``, grant requests, QueueJitCompilationTask's EnvironmentDesc
+— the scheduler's env-matched grant pools then gate jit grants to
+version-matching servants with no scheduler changes at all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..common.hashing import digest_keyed
+
+_ENV_DOMAIN = "ytpu-jit-env"
+
+
+def jit_env_digest(backend: str, jaxlib_version: str) -> str:
+    return digest_keyed(_ENV_DOMAIN, backend.encode(),
+                        jaxlib_version.encode())
+
+
+@dataclass(frozen=True)
+class JitEnvironment:
+    """One servable jit environment (a servant may expose several —
+    e.g. a TPU host also serves cpu-backend compiles)."""
+
+    backend: str
+    jaxlib_version: str
+
+    @property
+    def digest(self) -> str:
+        return jit_env_digest(self.backend, self.jaxlib_version)
+
+
+def local_jaxlib_version() -> str:
+    """The jaxlib version of THIS process, '' when jax is absent."""
+    try:
+        import jaxlib
+
+        return jaxlib.__version__
+    except Exception:
+        return ""
+
+
+def local_jit_environment(backend: str = "cpu") -> JitEnvironment:
+    """The environment this host can compile for.  ``backend`` is the
+    XLA platform name; tests and the loopback rig use "cpu" (the
+    compile worker forces JAX_PLATFORMS to it, so a TPU-attached
+    servant still produces cpu-backend artifacts when asked to)."""
+    return JitEnvironment(backend=backend,
+                          jaxlib_version=local_jaxlib_version())
+
+
+def default_jit_environments() -> list:
+    """What an unconfigured servant serves: this host's cpu-backend
+    environment iff a jaxlib is importable (an empty version string
+    would advertise an environment no real client ever asks for, and
+    its compiles would fail anyway), else nothing — jit serving is
+    opt-out by environment, not by flag."""
+    env = local_jit_environment("cpu")
+    return [env] if env.jaxlib_version else []

@@ -1,0 +1,201 @@
+"""Client side of the jit offload: submit a lowered computation to the
+local daemon, long-poll for the artifact, fall back locally.
+
+The jit analogue of client/compilation_saas.py: pure bytes in, bytes
+out — this module never imports jax (offload decisions must not pay a
+jax import on the client hot path; the thin jax-facing convenience
+lives in ``compile_lowered``, which imports lazily).  Protocol
+(doc/jit_offload.md):
+
+    POST /local/submit_jit_task    multi-chunk [json, zstd StableHLO]
+         400 -> fix the submission (the NeedJitEnvironment handshake;
+                this client always sends its environment, so a 400
+                means the submission itself is malformed: no retry)
+    POST /local/wait_for_jit_task  503 running (long-poll again),
+                                   404 unknown id,
+                                   200 multi-chunk [json, artifacts...]
+
+Every knob is an env var (YTPU_JIT_*, client/env_options.py), same as
+the C++ client: no flag parsing on an import path.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from google.protobuf import json_format
+
+from .. import api
+from ..client import env_options
+from ..client.daemon_call import call_daemon
+from ..common import compress, multi_chunk
+from ..common.hashing import digest_bytes
+from ..utils.logging import get_logger
+from .env import local_jit_environment
+
+logger = get_logger("jit.frontend")
+
+# One long-poll leg; the overall budget is YTPU_JIT_TIMEOUT_S.
+_WAIT_LEG_MS = 2000
+
+
+@dataclass
+class OffloadOutcome:
+    """What came back from the cluster for one computation.
+
+    ``ok`` distinguishes infrastructure outcomes (daemon unreachable,
+    no capacity, timeout — caller should fall back and compile locally)
+    from a *compile* failure, which is deterministic and would fail
+    locally too: there ``ok`` is True, ``exit_code`` non-zero and
+    ``error`` carries the worker's diagnostics."""
+
+    ok: bool
+    exit_code: int = -1
+    error: str = ""
+    # artifact key (".xla" = serialized executable) -> raw bytes.
+    artifacts: Dict[str, bytes] = field(default_factory=dict)
+
+    @property
+    def executable(self) -> Optional[bytes]:
+        """The serialized executable, when the compile succeeded."""
+        if self.ok and self.exit_code == 0:
+            return self.artifacts.get(".xla")
+        return None
+
+
+def offload_compile(
+    computation: bytes,
+    *,
+    compile_options: bytes = b"",
+    backend: str = "cpu",
+    jaxlib_version: Optional[str] = None,
+    cache_control: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+) -> OffloadOutcome:
+    """Submit one lowered computation (StableHLO text or MLIR bytecode
+    bytes) for remote compilation; blocks until artifact/failure/timeout.
+
+    Infrastructure failures return ``ok=False`` — by the YTPU_JIT_*
+    contract the caller then compiles locally (the same local-fallback
+    shape as the C++ client when the cluster has no capacity)."""
+    if not env_options.jit_offload_enabled():
+        return OffloadOutcome(ok=False, error="offload disabled")
+    if jaxlib_version is None:
+        jaxlib_version = local_jit_environment(backend).jaxlib_version
+    if not jaxlib_version:
+        return OffloadOutcome(ok=False, error="no local jaxlib version")
+    if timeout_s is None:
+        timeout_s = env_options.jit_timeout_s()
+
+    req = api.jit.SubmitJitTaskRequest(
+        requestor_process_id=os.getpid(),
+        computation_digest=digest_bytes(computation),
+        compile_options=bytes(compile_options),
+        backend=backend,
+        jaxlib_version=jaxlib_version,
+        cache_control=(env_options.cache_control()
+                       if cache_control is None else cache_control),
+    )
+    body = multi_chunk.make_multi_chunk_payload([
+        json_format.MessageToJson(req).encode(),
+        compress.compress(computation),
+    ])
+    resp = call_daemon("POST", "/local/submit_jit_task", body)
+    if resp.status != 200:
+        # -1: no daemon; 400: malformed submission (we DID send the
+        # environment, so there is nothing to report-and-retry).
+        return OffloadOutcome(
+            ok=False, error=f"submit failed: HTTP {resp.status} "
+                            f"{resp.body[:200]!r}")
+    task_id = json_format.Parse(
+        resp.body, api.jit.SubmitJitTaskResponse()).task_id
+    return _wait(task_id, timeout_s)
+
+
+def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return OffloadOutcome(ok=False,
+                                  error=f"timed out after {timeout_s}s")
+        wreq = api.jit.WaitForJitTaskRequest(
+            task_id=task_id,
+            milliseconds_to_wait=min(_WAIT_LEG_MS,
+                                     max(1, int(remaining * 1000))),
+        )
+        resp = call_daemon(
+            "POST", "/local/wait_for_jit_task",
+            json_format.MessageToJson(wreq).encode(),
+            timeout_s=_WAIT_LEG_MS / 1000.0 + 10.0)
+        if resp.status == 503:
+            continue  # still compiling
+        if resp.status != 200:
+            return OffloadOutcome(
+                ok=False, error=f"wait failed: HTTP {resp.status}")
+        chunks = multi_chunk.try_parse_multi_chunk(resp.body)
+        if not chunks:
+            return OffloadOutcome(ok=False, error="malformed wait reply")
+        msg = json_format.Parse(bytes(chunks[0]),
+                                api.jit.WaitForJitTaskResponse())
+        if msg.exit_code < 0:
+            # Daemon-side infrastructure failure (no grant, servant
+            # lost): fall back, this computation never compiled.
+            return OffloadOutcome(ok=False, exit_code=msg.exit_code,
+                                  error=msg.error)
+        artifacts: Dict[str, bytes] = {}
+        for key, chunk in zip(msg.artifact_keys, chunks[1:]):
+            data = compress.try_decompress(bytes(chunk))
+            if data is None:
+                return OffloadOutcome(
+                    ok=False, error=f"corrupt artifact chunk {key!r}")
+            artifacts[key] = data
+        return OffloadOutcome(ok=True, exit_code=msg.exit_code,
+                              error=msg.error, artifacts=artifacts)
+
+
+def compile_lowered(lowered, *, backend: str = "cpu"):
+    """Convenience for real JAX programs: ``jax.jit(f).lower(*args)`` →
+    compiled executable, via the cluster when possible.
+
+    On a successful offload the serialized artifact is deserialized
+    into this process's backend and returned as the runtime's loaded
+    executable (xla ``LoadedExecutable`` — cache-warm deserialize, no
+    local XLA run); on any infrastructure miss it returns
+    ``lowered.compile()`` (jax's ``Compiled`` wrapper) iff
+    YTPU_JIT_LOCAL_FALLBACK=1 (default), else raises RuntimeError.
+    Callers who need one uniform call surface should use
+    ``offload_compile`` + their own deserialize instead.  jax imports
+    stay inside this function."""
+    text = lowered.as_text()
+    outcome = offload_compile(text.encode(), backend=backend)
+    exe = outcome.executable
+    if exe is not None:
+        try:
+            import jax
+
+            client = None
+            for dev in jax.devices():
+                if dev.client.platform == backend:
+                    client = dev.client
+                    break
+            if client is not None:
+                loaded = client.deserialize_executable(exe)
+                logger.debug("jit offload hit: deserialized %d bytes",
+                             len(exe))
+                return loaded
+        except Exception as e:  # deserialize mismatch: fall back
+            logger.warning("artifact deserialize failed: %r", e)
+    if outcome.ok and outcome.exit_code != 0:
+        # A deterministic compile error: local compilation would fail
+        # identically, so surface the cluster's diagnostics.
+        raise RuntimeError(f"remote jit compile failed: {outcome.error}")
+    if not env_options.jit_local_fallback():
+        raise RuntimeError(
+            f"jit offload failed and local fallback is disabled: "
+            f"{outcome.error}")
+    return lowered.compile()

@@ -34,6 +34,7 @@ from ..daemon.local.local_task_monitor import LocalTaskMonitor
 from ..daemon.local.running_task_keeper import RunningTaskKeeper
 from ..daemon.local.task_grant_keeper import TaskGrantKeeper
 from ..daemon.sysinfo import LoadAverageSampler
+from ..jit.env import local_jit_environment
 from ..rpc import GrpcServer
 from ..scheduler.policy import make_policy
 from ..scheduler.service import SchedulerService
@@ -116,7 +117,11 @@ class _Servant:
         self.service = DaemonService(
             config, engine=self.engine, registry=self.registry,
             cache_writer=cache_writer, sampler=sampler,
-            allow_poor_machine=True, cgroup_present=False)
+            allow_poor_machine=True, cgroup_present=False,
+            # Every rig servant serves the host's cpu jit environment
+            # (YTPU_JIT_FAKE_WORKER=1 short-circuits the actual XLA
+            # invocation for control-plane tests and the simulator).
+            jit_environments=[local_jit_environment("cpu")])
         self.server.add_service(self.service.spec())
         self.server.start()
 
@@ -186,11 +191,18 @@ class LocalCluster:
             cache_reader=self.cache_reader,
             running_task_keeper=self.running_keeper,
         )
+        # Persistent-compile-cache shim plumbing, wired as entry.py
+        # wires it: reads through the delegate's Bloom-replicated
+        # reader, puts through a servant-role cache writer.
+        self.shim_cache_writer = DistributedCacheWriter(self.cache_uri,
+                                                        lambda: "")
         self.http = LocalHttpService(
             monitor=LocalTaskMonitor(nprocs=8, pid_prober=lambda p: True),
             digest_cache=FileDigestCache(),
             dispatcher=self.delegate,
             port=http_port,
+            cache_reader=self.cache_reader,
+            cache_writer=self.shim_cache_writer,
         )
         # Background keepers of extra delegates (anything with .stop()).
         self._extra_keepers: List = []

@@ -15,12 +15,22 @@ Duplicate sources (--dup-rate) exercise the dedup/join path; a second
 pass over the same sources exercises the distributed cache.  Numbers
 scale with host cores (each "compile" is a real subprocess); the point
 is a reproducible end-to-end artifact, not a hardware claim.
+
+`--workload jit` swaps the TU corpus for a synthetic StableHLO corpus
+with a duplicate-heavy pick distribution (a fleet jits the same handful
+of model steps over and over — far more duplication than a C++ build)
+and runs it through the SAME delegates via the jit DistributedTask.
+Compiles are the deterministic fake worker (YTPU_JIT_FAKE_WORKER=1 for
+the cluster's lifetime): the farm is under test, not XLA.  Adds
+``jit_compiles_per_sec`` and ``dedup_ratio`` (fraction of submissions
+that did NOT cost a servant compile) to the report.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import tempfile
 import threading
 import time
@@ -72,24 +82,71 @@ def _make_sized_sources(n_unique: int, sampler, rng):
     return sources
 
 
+def _make_stablehlo_corpus(n_unique: int, rng):
+    """Unique synthetic StableHLO-text modules of build-realistic sizes
+    (a lowered train step is tens-to-hundreds of KB of MLIR text).
+    Content is module-shaped text so the zstd ratio resembles real
+    lowerings, with a unique header so every module digests
+    differently."""
+    body_pool = b"".join(
+        b'    %%v%d = "stablehlo.add"(%%a%d, %%b%d) : '
+        b"(tensor<8x128xf32>, tensor<8x128xf32>) -> tensor<8x128xf32>\n"
+        % (i, i % 331, i % 257) for i in range(4000))
+    modules = []
+    for i in range(n_unique):
+        size = int(rng.integers(16 << 10, 192 << 10))
+        head = (f"module @jit_step_{i} attributes "
+                f"{{ytpu.sim_id = {i} : i32}} {{\n").encode()
+        modules.append(head + body_pool[:size] + b"}\n")
+    return modules
+
+
+def _zipf_picks(tasks: int, n_unique: int, rng):
+    """Duplicate-heavy pick distribution: every unique module appears
+    at least once, and the duplicate mass is Zipf-weighted toward a hot
+    head — a fleet re-jitting the same few model steps, not a uniform
+    spread of duplicates."""
+    extra = tasks - n_unique
+    ranks = rng.zipf(1.3, size=extra)
+    picks = list(range(n_unique)) + [int(r - 1) % n_unique for r in ranks]
+    rng.shuffle(picks)
+    return picks
+
+
 def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
         policy: str, in_flight: int = 0, compile_s: float = 0.05,
-        delegates: int = 1, tu_size_dist: str = "") -> dict:
+        delegates: int = 1, tu_size_dist: str = "",
+        workload: str = "cxx") -> dict:
     from ..common import compress
     from ..common.hashing import digest_bytes, digest_file
     from ..common.payload import copy_stats
     from ..daemon.local.cxx_task import CxxCompilationTask
+    from ..daemon.local.jit_task import JitCompilationTask
+    from ..jit.env import local_jit_environment
     from ..testing import LocalCluster, make_fake_compiler
 
+    if workload not in ("cxx", "jit"):
+        raise ValueError(f"unknown workload {workload!r}")
     # NB: no "ytpu" in the path — CompilerRegistry treats paths
     # containing the client-wrapper markers as wrappers and skips them.
     tmp = Path(tempfile.mkdtemp(prefix="csim_"))
-    compiler = make_fake_compiler(str(tmp / "bin"), compile_s=compile_s)
-    compiler_digest = digest_file(compiler)
+    saved_env = {k: os.environ.get(k)
+                 for k in ("YTPU_JIT_FAKE_WORKER", "YTPU_JIT_FAKE_SLEEP_S")}
+    if workload == "jit":
+        # Deterministic pseudo-compiles with the same duration knob the
+        # fake g++ gets: measure the farm, not XLA.
+        os.environ["YTPU_JIT_FAKE_WORKER"] = "1"
+        os.environ["YTPU_JIT_FAKE_SLEEP_S"] = str(compile_s)
+        compiler_dirs = []
+    else:
+        compiler = make_fake_compiler(str(tmp / "bin"),
+                                      compile_s=compile_s)
+        compiler_digest = digest_file(compiler)
+        compiler_dirs = [str(tmp / "bin")]
     cluster = LocalCluster(
         tmp, n_servants=servants, policy=policy,
         servant_concurrency=concurrency,
-        compiler_dirs=[str(tmp / "bin")])
+        compiler_dirs=compiler_dirs)
     # Several "build machines": each extra delegate owns its own grant
     # keeper and running-task snapshot, so duplicate TUs can join
     # across machines (the cluster-wide dedup path).
@@ -99,21 +156,37 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
     rng = np.random.default_rng(1)
     n_unique = max(1, int(tasks * (1.0 - dup_rate)))
-    sampler = _parse_tu_size_dist(tu_size_dist)
-    if sampler is None:
-        sources = [f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
-                   for i in range(n_unique)]
+    if workload == "jit":
+        sources = _make_stablehlo_corpus(n_unique, rng)
+        picks = _zipf_picks(tasks, n_unique, rng)
+        jit_env = local_jit_environment("cpu")
     else:
-        sources = _make_sized_sources(n_unique, sampler, rng)
-    picks = list(range(n_unique)) + list(
-        rng.integers(0, n_unique, tasks - n_unique))
-    # Interleave duplicates with their originals so some arrive while
-    # the original is still compiling (the join/ReferenceTask path),
-    # and some after (the cache path).
-    rng.shuffle(picks)
+        sampler = _parse_tu_size_dist(tu_size_dist)
+        if sampler is None:
+            sources = [
+                f"// TU {i}\nint f{i}() {{ return {i}; }}\n".encode()
+                for i in range(n_unique)]
+        else:
+            sources = _make_sized_sources(n_unique, sampler, rng)
+        picks = list(range(n_unique)) + list(
+            rng.integers(0, n_unique, tasks - n_unique))
+        # Interleave duplicates with their originals so some arrive
+        # while the original is still compiling (the join/ReferenceTask
+        # path), and some after (the cache path).
+        rng.shuffle(picks)
 
-    def make_task(i: int) -> CxxCompilationTask:
+    def make_task(i: int):
         src = sources[picks[i]]
+        if workload == "jit":
+            return JitCompilationTask(
+                requestor_pid=1,
+                computation_digest=digest_bytes(src),
+                compile_options=b"",
+                backend="cpu",
+                jaxlib_version=jit_env.jaxlib_version,
+                cache_control=1,
+                compressed_computation=compress.compress(src),
+            )
         return CxxCompilationTask(
             requestor_pid=1,
             source_path=f"/src/tu{picks[i]}.cc",
@@ -163,6 +236,19 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
     source_bytes_total = sum(len(sources[picks[i]]) for i in range(tasks))
     copies0 = copy_stats()["copies"]
+    # Tight Bloom sync for the rig: the production 10s replica cadence
+    # is longer than a whole smoke run, which would misreport the dedup
+    # ratio as near-zero when the cache in fact absorbed the
+    # duplicates.  One syncer covers every delegate (they share the
+    # cluster's reader).
+    sync_stop = threading.Event()
+
+    def _bloom_syncer():
+        while not sync_stop.wait(timeout=0.25):
+            cluster.cache_reader.sync_once()
+
+    threading.Thread(target=_bloom_syncer, name="sim-bloom-sync",
+                     daemon=True).start()
     try:
         t_start = time.perf_counter()
         threads = [threading.Thread(target=worker, daemon=True)
@@ -182,6 +268,7 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
         stats = {k: sum(d.inspect()["stats"][k] for d in all_delegates)
                  for k in ("hit_cache", "reused", "actually_run", "failed")}
         out = {
+            "workload": workload,
             "tasks": tasks,
             "delegates": delegates,
             "servants": servants,
@@ -194,6 +281,15 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
             "p99_latency_ms": pctl(99),
             "breakdown": stats,
         }
+        if workload == "jit":
+            # Dedup ratio: fraction of resolved submissions that did
+            # NOT cost a servant compile (cache hit or in-flight join)
+            # — the cluster-wide dedup claim in one number.
+            resolved = sum(stats.values()) - stats["failed"]
+            out["jit_compiles_per_sec"] = round(tasks / wall, 1)
+            out["servant_compiles"] = stats["actually_run"]
+            out["dedup_ratio"] = round(
+                1.0 - stats["actually_run"] / max(1, resolved), 3)
         if tu_size_dist:
             # Byte-heavy mode: the workload is about moving bytes, so
             # report how many moved and how often they were copied
@@ -206,10 +302,27 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
                 (copy_stats()["copies"] - copies0) / max(1, tasks), 1)
         return out
     finally:
+        sync_stop.set()
         cluster.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
-def main() -> None:
+def quick_jit_compiles_per_sec() -> float:
+    """Small fixed jit-workload run for bench.py's riding-along field:
+    end-to-end jit submissions/s through the full loopback farm (fake
+    worker — the farm is the unit under test, not XLA)."""
+    out = run(tasks=60, servants=2, concurrency=2, dup_rate=0.5,
+              policy="greedy_cpu", compile_s=0.0, workload="jit")
+    if out["failures"]:
+        raise RuntimeError(f"jit quick run failed: {out['failures']}")
+    return float(out["jit_compiles_per_sec"])
+
+
+def main() -> int:
     ap = argparse.ArgumentParser("ytpu-cluster-sim")
     ap.add_argument("--tasks", type=int, default=2000)
     ap.add_argument("--servants", type=int, default=4)
@@ -218,20 +331,46 @@ def main() -> None:
     ap.add_argument("--delegates", type=int, default=1,
                     help="simulated build machines (cross-machine dedup)")
     ap.add_argument("--policy", default="greedy_cpu")
+    ap.add_argument("--workload", default="cxx", choices=("cxx", "jit"),
+                    help="task corpus: C++ TUs, or a duplicate-heavy "
+                         "synthetic StableHLO corpus through the jit "
+                         "DistributedTask (doc/jit_offload.md)")
     ap.add_argument("--tu-size-dist", default="",
                     help="TU size distribution: fixed:N, uniform:MIN:MAX,"
                          " or 'byte-heavy' (uniform 128KB..1MB)")
     ap.add_argument("--compile-s", type=float, default=0.05,
-                    help="fake compile duration per TU (seconds)")
+                    help="fake compile duration per task (seconds)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: small run; exit 1 on any failure or, "
+                         "for jit, if dedup never engaged")
     args = ap.parse_args()
-    print(json.dumps(run(args.tasks, args.servants, args.concurrency,
-                         args.dup_rate, args.policy,
-                         compile_s=args.compile_s,
-                         delegates=args.delegates,
-                         tu_size_dist=args.tu_size_dist), indent=2))
+    if args.smoke:
+        args.tasks = min(args.tasks, 60)
+        args.servants = min(args.servants, 2)
+        args.dup_rate = max(args.dup_rate, 0.5)
+    out = run(args.tasks, args.servants, args.concurrency,
+              args.dup_rate, args.policy,
+              compile_s=args.compile_s if not args.smoke else 0.0,
+              delegates=args.delegates,
+              tu_size_dist=args.tu_size_dist,
+              workload=args.workload)
+    print(json.dumps(out, indent=2))
+    if args.smoke:
+        if out["failures"]:
+            print(f"SMOKE FAILED: {out['failures']} failed tasks")
+            return 1
+        if args.workload == "jit" and out["dedup_ratio"] <= 0:
+            print("SMOKE FAILED: duplicate-heavy jit run never deduped")
+            return 1
+    return 0
 
 
 if __name__ == "__main__":
+    import sys
+
     from ..utils.device_guard import guard_device_entry
 
-    guard_device_entry(main, module="yadcc_tpu.tools.cluster_sim")
+    # The guard's child path discards main's return value, so the smoke
+    # gate's exit code must be raised, not returned.
+    guard_device_entry(lambda: sys.exit(main()),
+                       module="yadcc_tpu.tools.cluster_sim")
