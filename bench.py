@@ -401,8 +401,25 @@ def main() -> None:
     except Exception:
         jit_cps = None
 
+    # Hostile-world survival canaries (tools/scenarios.py,
+    # doc/robustness.md): the p99 latency of an explicit REJECT verdict
+    # under a smoke 4x-overload ladder storm (a rejection is an
+    # immediate answer, not a queue wait), and the compile success rate
+    # — local fallback counted — under a smoke flaky-servant run.
+    try:
+        from yadcc_tpu.tools.scenarios import quick_hostile_metrics
+
+        hostile = quick_hostile_metrics()
+    except Exception:
+        hostile = {}
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 6 (r11+): adds `overload_reject_p99_ms` and
+        # `survival_compile_success_rate` from the hostile-world
+        # scenario harness (tools/scenarios.py smoke runs of the
+        # overload-ladder and flaky-servant scenarios;
+        # doc/robustness.md).
         # Version 5 (r09+): adds `jit_compiles_per_sec` — end-to-end
         # jit-offload submissions/s through the loopback farm with the
         # deterministic fake worker (tools/cluster_sim --workload jit;
@@ -418,7 +435,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 5,
+        "harness_version": 6,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -451,6 +468,11 @@ def main() -> None:
         "heartbeats_per_sec": beats_per_sec,
         "bloom_fingerprint_mkeys_per_sec": bloom_fp,
         "dataplane_mb_per_sec": dataplane_mb,
+        # (v5 documented this field but never emitted it — fixed in v6.)
+        "jit_compiles_per_sec": jit_cps,
+        "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
+        "survival_compile_success_rate": hostile.get(
+            "survival_compile_success_rate"),
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

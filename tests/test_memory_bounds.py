@@ -69,7 +69,7 @@ def test_grant_keeper_retires_idle_fetchers(monkeypatch):
 
     k = TaskGrantKeeper("mock://nowhere", "")
     freed = []
-    monkeypatch.setattr(k, "_fetch", lambda *a, **kw: [])
+    monkeypatch.setattr(k, "_fetch", lambda *a, **kw: ([], 0, 0.0))
     monkeypatch.setattr(k, "_free_async", lambda ids: freed.extend(ids))
     monkeypatch.setattr(TaskGrantKeeper, "IDLE_FETCHER_TTL_S", 0.0)
     try:
@@ -101,7 +101,7 @@ def test_grant_keeper_thread_count_bounded_under_churn(monkeypatch):
     from yadcc_tpu.daemon.local.task_grant_keeper import TaskGrantKeeper
 
     k = TaskGrantKeeper("mock://nowhere", "")
-    monkeypatch.setattr(k, "_fetch", lambda *a, **kw: [])
+    monkeypatch.setattr(k, "_fetch", lambda *a, **kw: ([], 0, 0.0))
     monkeypatch.setattr(k, "_free_async", lambda ids: None)
     monkeypatch.setattr(TaskGrantKeeper, "IDLE_FETCHER_TTL_S", 0.0)
     baseline = {t.ident for t in threading.enumerate()
@@ -219,7 +219,7 @@ def test_retired_fetcher_frees_in_flight_grants(monkeypatch):
     def slow_fetch(env, immediate, prefetch):
         in_fetch.set()
         release_fetch.wait(5)
-        return [(4242, "10.0.0.1:1")]
+        return [(4242, "10.0.0.1:1")], 0, 0.0
 
     monkeypatch.setattr(k, "_fetch", slow_fetch)
     monkeypatch.setattr(k, "_free_async", lambda ids: freed.extend(ids))

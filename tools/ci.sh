@@ -71,6 +71,21 @@ if ! python -m yadcc_tpu.tools.cluster_sim --workload jit --smoke; then
   fail=1
 fi
 
+echo "== chaos smoke (hostile-world scenario gates) =="
+# Robustness gates (doc/robustness.md): a flaky servant must not cost
+# a single task (survival via retries + local fallback), and the
+# overload ladder must reach REJECT under synthetic 4x overload and
+# recover to NORMAL with hysteresis.  SLOs are asserted inside the
+# tool (tools/scenarios.py); any miss exits non-zero.
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario flaky-servant --smoke; then
+  echo "chaos smoke (flaky-servant) FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario overload-ladder --smoke; then
+  echo "chaos smoke (overload-ladder) FAILED" >&2
+  fail=1
+fi
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 "${YTPU_CI_TEST_TIMEOUT:-870}" \

@@ -53,21 +53,43 @@ _SCALARS = {
 
 def _msg(fd, name: str, *fields):
     """Append message ``name`` with ``(fname, number, type[, opts])``
-    fields to FileDescriptorProto ``fd``.  ``type`` is a _SCALARS key or
-    ``.ytpu.api.X`` for a message reference; opts may include
-    ``repeated``."""
+    fields to FileDescriptorProto ``fd``.  ``type`` is a _SCALARS key,
+    ``.ytpu.api.X`` for a message reference, or ``enum:.ytpu.api.X``
+    for an enum reference; opts may include ``repeated``."""
     m = fd.message_type.add(name=name)
     for spec in fields:
         fname, number, ftype = spec[:3]
         repeated = "repeated" in spec[3:]
         f = m.field.add(name=fname, number=number,
                         label=3 if repeated else 1)  # REPEATED / OPTIONAL
-        if ftype.startswith("."):
+        if ftype.startswith("enum:"):
+            f.type = 14  # TYPE_ENUM
+            f.type_name = ftype[len("enum:"):]
+        elif ftype.startswith("."):
             f.type = 11  # TYPE_MESSAGE
             f.type_name = ftype
         else:
             f.type = _SCALARS[ftype]
     return m
+
+
+def _enum(fd, name: str, *values):
+    """Append top-level enum ``name`` with ``(vname, number)`` values."""
+    e = fd.enum_type.add(name=name)
+    for vname, number in values:
+        e.value.add(name=vname, number=number)
+    return e
+
+
+def _service(fd, name: str, *methods):
+    """Append service ``name`` with ``(mname, in_type, out_type)``
+    methods (full type names).  Kept for descriptor fidelity with the
+    protoc build; nothing dispatches through it at runtime (services
+    are routed by name strings in rpc/)."""
+    s = fd.service.add(name=name)
+    for mname, in_type, out_type in methods:
+        s.method.add(name=mname, input_type=in_type, output_type=out_type)
+    return s
 
 
 def _jit_descriptor():
@@ -109,7 +131,116 @@ def _jit_descriptor():
     return fd
 
 
-PURE_BUILDERS = {"jit.proto": _jit_descriptor}
+def _scheduler_descriptor():
+    """scheduler.proto as a FileDescriptorProto — pure-maintained since
+    the overload-ladder flow-control fields were added on a box without
+    protoc.  MUST stay field-for-field identical to protos/
+    scheduler.proto (the human-readable source of truth)."""
+    from google.protobuf import descriptor_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="scheduler.proto", package="ytpu.api", syntax="proto3",
+        dependency=["env_desc.proto"])
+    _enum(fd, "SchedulerStatus",
+          ("SCHEDULER_STATUS_OK", 0),
+          ("SCHEDULER_STATUS_NO_QUOTA_AVAILABLE", 1001),
+          ("SCHEDULER_STATUS_NOT_IMPLEMENTED", 1002),
+          ("SCHEDULER_STATUS_ACCESS_DENIED", 1003),
+          ("SCHEDULER_STATUS_INVALID_ARGUMENT", 1004),
+          ("SCHEDULER_STATUS_VERSION_TOO_OLD", 1005),
+          ("SCHEDULER_STATUS_ENVIRONMENT_NOT_AVAILABLE", 1006))
+    _enum(fd, "ServantPriority",
+          ("SERVANT_PRIORITY_UNKNOWN", 0),
+          ("SERVANT_PRIORITY_DEDICATED", 1),
+          ("SERVANT_PRIORITY_USER", 2))
+    _enum(fd, "NotAcceptingTaskReason",
+          ("NOT_ACCEPTING_TASK_REASON_NONE", 0),
+          ("NOT_ACCEPTING_TASK_REASON_USER_INSTRUCTED", 1),
+          ("NOT_ACCEPTING_TASK_REASON_POOR_MACHINE", 2),
+          ("NOT_ACCEPTING_TASK_REASON_CGROUPS_PRESENT", 3),
+          ("NOT_ACCEPTING_TASK_REASON_BEHIND_NAT", 4),
+          ("NOT_ACCEPTING_TASK_REASON_NOT_VERIFIED", 100))
+    _enum(fd, "StartingTaskReason",
+          ("STARTING_TASK_REASON_UNKNOWN", 0),
+          ("STARTING_TASK_REASON_NORMAL", 1),
+          ("STARTING_TASK_REASON_PREFETCH", 2))
+    # Overload-ladder verdicts on the grant path (doc/robustness.md):
+    # the scheduler's explicit alternative to silently granting nothing.
+    _enum(fd, "FlowControlVerdict",
+          ("FLOW_CONTROL_NONE", 0),
+          ("FLOW_CONTROL_COMPILE_LOCALLY", 1),
+          ("FLOW_CONTROL_REJECT", 2))
+    _msg(fd, "RunningTask",
+         ("servant_task_id", 1, "uint64"),
+         ("task_grant_id", 2, "uint64"),
+         ("servant_location", 3, "string"),
+         ("task_digest", 4, "string"))
+    _msg(fd, "HeartbeatRequest",
+         ("token", 1, "string"),
+         ("next_heartbeat_in_ms", 2, "uint32"),
+         ("version", 3, "uint32"),
+         ("location", 4, "string"),
+         ("num_processors", 5, "uint32"),
+         ("current_load", 6, "uint32"),
+         ("priority", 7, "enum:.ytpu.api.ServantPriority"),
+         ("not_accepting_task_reason", 8, "uint32"),
+         ("capacity", 9, "uint32"),
+         ("total_memory_in_bytes", 10, "uint64"),
+         ("memory_available_in_bytes", 11, "uint64"),
+         ("env_descs", 12, ".ytpu.api.EnvironmentDesc", "repeated"),
+         ("running_tasks", 13, ".ytpu.api.RunningTask", "repeated"))
+    _msg(fd, "HeartbeatResponse",
+         ("acceptable_tokens", 1, "string", "repeated"),
+         ("expired_tasks", 2, "uint64", "repeated"))
+    _msg(fd, "GetConfigRequest", ("token", 1, "string"))
+    _msg(fd, "GetConfigResponse", ("serving_daemon_token", 1, "string"))
+    _msg(fd, "StartingTaskGrant",
+         ("task_grant_id", 1, "uint64"),
+         ("servant_location", 2, "string"))
+    _msg(fd, "WaitForStartingTaskRequest",
+         ("token", 1, "string"),
+         ("milliseconds_to_wait", 2, "uint32"),
+         ("env_desc", 3, ".ytpu.api.EnvironmentDesc"),
+         ("immediate_reqs", 4, "uint32"),
+         ("prefetch_reqs", 5, "uint32"),
+         ("next_keep_alive_in_ms", 6, "uint32"),
+         ("min_version", 7, "uint32"))
+    _msg(fd, "WaitForStartingTaskResponse",
+         ("grants", 1, ".ytpu.api.StartingTaskGrant", "repeated"),
+         ("flow_control", 2, "uint32"),
+         ("retry_after_ms", 3, "uint32"),
+         ("degradation_rung", 4, "uint32"))
+    _msg(fd, "KeepTaskAliveRequest",
+         ("token", 1, "string"),
+         ("task_grant_ids", 2, "uint64", "repeated"),
+         ("next_keep_alive_in_ms", 3, "uint32"))
+    _msg(fd, "KeepTaskAliveResponse",
+         ("statuses", 1, "bool", "repeated"))
+    _msg(fd, "FreeTaskRequest",
+         ("token", 1, "string"),
+         ("task_grant_ids", 2, "uint64", "repeated"))
+    _msg(fd, "FreeTaskResponse")
+    _msg(fd, "GetRunningTasksRequest")
+    _msg(fd, "GetRunningTasksResponse",
+         ("running_tasks", 1, ".ytpu.api.RunningTask", "repeated"))
+    _service(fd, "SchedulerService",
+             ("Heartbeat", ".ytpu.api.HeartbeatRequest",
+              ".ytpu.api.HeartbeatResponse"),
+             ("GetConfig", ".ytpu.api.GetConfigRequest",
+              ".ytpu.api.GetConfigResponse"),
+             ("WaitForStartingTask", ".ytpu.api.WaitForStartingTaskRequest",
+              ".ytpu.api.WaitForStartingTaskResponse"),
+             ("KeepTaskAlive", ".ytpu.api.KeepTaskAliveRequest",
+              ".ytpu.api.KeepTaskAliveResponse"),
+             ("FreeTask", ".ytpu.api.FreeTaskRequest",
+              ".ytpu.api.FreeTaskResponse"),
+             ("GetRunningTasks", ".ytpu.api.GetRunningTasksRequest",
+              ".ytpu.api.GetRunningTasksResponse"))
+    return fd
+
+
+PURE_BUILDERS = {"jit.proto": _jit_descriptor,
+                 "scheduler.proto": _scheduler_descriptor}
 
 _PURE_TEMPLATE = '''\
 # -*- coding: utf-8 -*-
@@ -175,6 +306,10 @@ def build() -> None:
             flags=re.MULTILINE,
         )
         py.write_text(src)
+    # Pure-maintained protos have ONE canonical generated form (the
+    # pure build): re-emit them last so a protoc box and a protoc-less
+    # box commit byte-identical gen/ modules.
+    build_pure()
     print(f"generated {len(PROTOS)} modules into {GEN_DIR}")
 
 

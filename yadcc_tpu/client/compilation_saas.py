@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..common.backoff import Backoff
 from ..common.compress import try_decompress
 from ..common.hashing import digest_file
 from ..common.multi_chunk import (make_multi_chunk_payload,
@@ -99,12 +100,23 @@ def wait_for_compilation_task(
     deadline = time.monotonic() + timeout_s
     body = json.dumps({"task_id": str(task_id),
                        "milliseconds_to_wait": 2000}).encode()
+    # The daemon normally paces this loop server-side (each 503 already
+    # cost a 2s long-poll leg).  A 503 that comes back FAST — a loaded
+    # daemon shedding its wait queue, or a proxy answering for it — used
+    # to spin; those legs now pace through the shared backoff, honoring
+    # any Retry-After the daemon attached.
+    backoff = Backoff(initial_s=0.05, max_s=2.0)
     while True:
         if time.monotonic() > deadline:
             raise CloudError("compilation timed out")
+        leg_start = time.monotonic()
         resp = call_daemon("POST", "/local/wait_for_cxx_task", body,
                            timeout_s=15.0)
         if resp.status == 503:
+            if time.monotonic() - leg_start < 0.5:
+                backoff.wait(resp.retry_after_s)
+            else:
+                backoff.reset()  # a real long-poll leg: not a spin
             continue  # still running
         if resp.status != 200:
             raise CloudError(f"wait failed: HTTP {resp.status}")

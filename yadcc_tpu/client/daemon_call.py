@@ -19,6 +19,10 @@ from .env_options import daemon_port
 class DaemonResponse:
     status: int
     body: bytes
+    # Server pacing hint (seconds), parsed from a Retry-After header on
+    # backpressure replies (503 under quota/overload); None when the
+    # daemon sent none.  Retry loops feed it to common.backoff.Backoff.
+    retry_after_s: Optional[float] = None
 
 
 # Test seam: when set, calls go here instead of the network.
@@ -52,6 +56,20 @@ def call_daemon(method: str, path: str, body=b"",
         resp = conn.getresponse()
         data = resp.read()
         conn.close()
-        return DaemonResponse(resp.status, data)
+        return DaemonResponse(resp.status, data,
+                              retry_after_s=_parse_retry_after(
+                                  resp.getheader("Retry-After")))
     except OSError:
         return DaemonResponse(-1, b"")
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Delay-seconds form only (the daemon is the only server we talk
+    to and it sends numbers); dates and garbage read as no hint."""
+    if not value:
+        return None
+    try:
+        v = float(value)
+    except ValueError:
+        return None
+    return v if v >= 0 else None

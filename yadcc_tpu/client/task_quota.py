@@ -12,12 +12,14 @@ import json
 import os
 import time
 
+from ..common.backoff import Backoff
 from . import logging as log
 from .daemon_call import call_daemon
 from .env_options import warn_on_wait, warn_on_wait_longer_than_s
 
 
-def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0) -> bool:
+def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0,
+                       _sleep=time.sleep) -> bool:
     start = time.monotonic()
     body = json.dumps({
         "milliseconds_to_wait": int(min(timeout_s, 10.0) * 1000),
@@ -25,6 +27,13 @@ def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0) -> bool:
         "requestor_pid": os.getpid(),
     }).encode()
     warned = False
+    # 503 is the daemon's paced backpressure (it already blocked our
+    # wait window server-side) — but any OTHER unexpected status (500
+    # handler crash, 404 from an older daemon) used to re-POST with
+    # zero delay until the 3600s timeout: a hot spin against a loopback
+    # socket.  Every non-200 retry now paces through the shared backoff,
+    # honoring the daemon's Retry-After when it sent one.
+    backoff = Backoff(initial_s=0.05, max_s=5.0, sleep=_sleep)
     while True:
         resp = call_daemon("POST", "/local/acquire_quota", body)
         if resp.status == 200:
@@ -38,6 +47,7 @@ def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0) -> bool:
             log.warning("waiting for local task quota "
                         "(machine busy; this is backpressure, not a hang)")
             warned = True
+        backoff.wait(resp.retry_after_s)
 
 
 def release_task_quota() -> None:

@@ -39,6 +39,18 @@ class DistributedTask:
 
     kind = "unknown"
 
+    # Weighted-fair grant admission (doc/robustness.md): grants are
+    # handed out fair-share across fairness keys, weighted by this.  A
+    # task kind may override either (e.g. a build-session id instead of
+    # a pid, or a lower weight for bulk background work).
+    fairness_weight = 1.0
+
+    def fairness_key(self) -> str:
+        """Requestor identity for fair grant hand-out.  Default: the
+        submitting process — every implementation exposes
+        ``requestor_pid`` (it already must, for the orphan-kill timer)."""
+        return str(getattr(self, "requestor_pid", 0))
+
     # Cache policy (reference distributed_task.h:36 CacheControl):
     CACHE_DISALLOW = 0  # never read, never fill
     CACHE_ALLOW = 1     # read and fill

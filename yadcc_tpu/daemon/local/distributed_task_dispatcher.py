@@ -91,7 +91,8 @@ class DistributedTaskDispatcher:
         self._next_id = 1  # guarded by: self._lock
         self._channels: Dict[str, Channel] = {}  # guarded by: self._lock
         self.stats = {"hit_cache": 0, "reused": 0, "actually_run": 0,
-                      "failed": 0}  # guarded by: self._lock
+                      "failed": 0,
+                      "shed_to_local": 0}  # guarded by: self._lock
         # Same counters split per task kind ("cxx"/"jit"/...): the
         # aggregate above is the long-standing public surface, the
         # split is what a mixed-workload deployment actually watches.
@@ -224,8 +225,20 @@ class DistributedTaskDispatcher:
         return result
 
     def _start_new_servant_task(self, entry: _Entry) -> TaskResult:
-        grant = self._grants.get(entry.task.get_env_digest(), timeout_s=10.0)
+        grant = self._grants.get(entry.task.get_env_digest(), timeout_s=10.0,
+                                 client_key=entry.task.fairness_key(),
+                                 weight=entry.task.fairness_weight)
         if grant is None:
+            if self._grants.local_only_active():
+                # Explicit overload-ladder verdict, not a timeout: the
+                # scheduler told this box to use its own CPU.  Count it
+                # so a fleet shedding load is visible in /inspect.
+                with self._lock:
+                    self._bump_locked(entry.task.kind, "shed_to_local")
+                return TaskResult(
+                    exit_code=-1,
+                    standard_error=b"cluster overloaded (LOCAL_ONLY "
+                                   b"verdict): compile locally")
             return TaskResult(
                 exit_code=-1,
                 standard_error=b"no compile capacity available in cluster")

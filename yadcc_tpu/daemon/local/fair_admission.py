@@ -1,0 +1,176 @@
+"""Per-client weighted-fair grant admission (delegate side).
+
+The grant keeper used to hand grants to whichever waiter thread won the
+``queue.Queue`` race — FIFO across *threads*, which under a `make -j500`
+or an oversized-TU adversary is FIFO across *one client's* five hundred
+threads: everyone else on the box starves.  This module replaces the
+hand-out with stride scheduling over requestor keys: every client
+carries a virtual pass; each grant goes to the waiting client with the
+lowest pass, whose pass then advances by ``quantum / weight``.  Two
+clients with equal weights therefore alternate no matter how many
+waiter threads each parks, and a weight-2 client legitimately draws
+twice the share.
+
+Properties the tests assert (tests/test_robustness.py):
+
+  * with an adversary submitting at 10x, every other client still
+    receives >= 80% of its equal share;
+  * an idle client returning does NOT burst accumulated credit — its
+    pass is clamped to the queue's current virtual time on arrival;
+  * no grant is lost: items offered while a waiter times out stay in
+    the backlog for the next waiter.
+
+One instance per env fetcher; the lock is a leaf (nothing is called
+out of it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class _Waiter:
+    __slots__ = ("key", "weight", "item")
+
+    def __init__(self, key: str, weight: float):
+        self.key = key
+        self.weight = weight
+        self.item = None
+
+
+class _Client:
+    __slots__ = ("vpass", "waiters", "granted", "last_active")
+
+    def __init__(self, vpass: float, now: float):
+        self.vpass = vpass
+        self.waiters: List[_Waiter] = []
+        self.granted = 0
+        self.last_active = now
+
+
+class FairGrantQueue:
+    """Weighted-fair item hand-out keyed by client string."""
+
+    QUANTUM = 1024.0
+    # Client records idle this long are dropped (their pass history is
+    # clamped away on return anyway); bounds memory under pid churn.
+    CLIENT_TTL_S = 600.0
+
+    def __init__(self, time_fn: Callable[[], float] = time.monotonic):
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._backlog: List = []  # guarded by: self._lock
+        self._clients: Dict[str, _Client] = {}  # guarded by: self._lock
+        self._vtime = 0.0  # guarded by: self._lock
+        self._closed = False  # guarded by: self._lock
+
+    # -- producer ------------------------------------------------------------
+
+    def put(self, item) -> None:
+        with self._cond:
+            self._backlog.append(item)
+            self._match_locked()
+            self._cond.notify_all()
+
+    # -- consumer ------------------------------------------------------------
+
+    def get(self, key: str = "", weight: float = 1.0,
+            timeout_s: float = 10.0):
+        """Block until this client is handed an item or the timeout
+        lapses (returns None).  ``key`` identifies the client for
+        fairness; "" is a shared anonymous client."""
+        deadline = self._time() + timeout_s
+        with self._cond:
+            if self._closed:
+                return None
+            c = self._client_locked(key)
+            w = _Waiter(key, weight)
+            c.waiters.append(w)
+            # Registering may unblock OTHER waiters too (the backlog is
+            # matched by fairness order, not arrival order): notify.
+            self._match_locked()
+            self._cond.notify_all()
+            while w.item is None and not self._closed:
+                remaining = deadline - self._time()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            if w.item is None:
+                # Timed out: deregister.  A racing put() matched under
+                # this same lock, so w.item is authoritative here.
+                if w in c.waiters:
+                    c.waiters.remove(w)
+                return None
+            return w.item
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._backlog)
+
+    def waiter_count(self) -> int:
+        with self._cond:
+            return sum(len(c.waiters) for c in self._clients.values())
+
+    def close(self) -> None:
+        """Stop matching: waiters return None, and every item offered
+        from now on stays in the backlog for drain().  Used at fetcher
+        retirement so a fetch that lands AFTER retire() hands its
+        grants back to the scheduler instead of to a late waiter of a
+        dead fetcher (who should re-register on a fresh one)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain(self) -> list:
+        """Remove and return every unclaimed item (fetcher retirement:
+        the grants go back to the scheduler)."""
+        with self._cond:
+            items, self._backlog = self._backlog, []
+            return items
+
+    def share_counts(self) -> Dict[str, int]:
+        """Grants handed out per client key since construction — the
+        fairness-dispersion measurement the scenario harness reports."""
+        with self._cond:
+            return {k: c.granted for k, c in self._clients.items()
+                    if c.granted}
+
+    # -- locked internals ----------------------------------------------------
+
+    def _client_locked(self, key: str) -> _Client:
+        now = self._time()
+        c = self._clients.get(key)
+        if c is None:
+            if len(self._clients) > 256:
+                for k in [k for k, cl in self._clients.items()
+                          if not cl.waiters
+                          and now - cl.last_active > self.CLIENT_TTL_S]:
+                    del self._clients[k]
+            c = self._clients[key] = _Client(self._vtime, now)
+        else:
+            # Returning idle client: clamp to current virtual time so
+            # accumulated "credit" from sitting out cannot burst.
+            c.vpass = max(c.vpass, self._vtime)
+        c.last_active = now
+        return c
+
+    def _match_locked(self) -> None:
+        if self._closed:
+            return
+        while self._backlog:
+            best: Optional[_Client] = None
+            for c in self._clients.values():
+                if c.waiters and (best is None or c.vpass < best.vpass):
+                    best = c
+            if best is None:
+                return
+            w = best.waiters.pop(0)
+            w.item = self._backlog.pop(0)
+            self._vtime = best.vpass
+            best.vpass += self.QUANTUM / max(w.weight, 1e-6)
+            best.granted += 1

@@ -106,7 +106,8 @@ class LocalHttpService:
                 pass
 
             def _reply(self, code: int, body=b"",
-                       content_type: str = "application/json"):
+                       content_type: str = "application/json",
+                       retry_after_s: Optional[float] = None):
                 # `body` may be a chunked Payload: gather-write its
                 # segments (wfile buffers small ones; a multi-MB object
                 # file goes straight from the servant-reply buffer to
@@ -114,6 +115,10 @@ class LocalHttpService:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after_s is not None:
+                    # Backpressure pacing hint; clients feed it to
+                    # common.backoff.Backoff instead of guessing.
+                    self.send_header("Retry-After", f"{retry_after_s:g}")
                 self.end_headers()
                 if isinstance(body, Payload):
                     for seg in body.iter_segments():
@@ -169,8 +174,15 @@ class LocalHttpService:
             ok = self.monitor.wait_for_running_new_task_permission(
                 req.requestor_pid, req.lightweight_task,
                 req.milliseconds_to_wait / 1000.0)
-            handler._reply(200 if ok else 503,
-                           _to_json(api.local.AcquireQuotaResponse()))
+            if ok:
+                handler._reply(200,
+                               _to_json(api.local.AcquireQuotaResponse()))
+            else:
+                # The machine is saturated and the caller already waited
+                # its full window; come back after a beat, not instantly.
+                handler._reply(503,
+                               _to_json(api.local.AcquireQuotaResponse()),
+                               retry_after_s=0.5)
             return
         if path == "/local/release_quota":
             req = _from_json(api.local.ReleaseQuotaRequest, body)

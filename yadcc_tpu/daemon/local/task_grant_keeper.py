@@ -11,20 +11,25 @@ than handed out.
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ... import api
+from ...common.backoff import Backoff
 from ...rpc import Channel, RpcError
 from ...utils.logging import get_logger
+from .fair_admission import FairGrantQueue
 
 logger = get_logger("daemon.grant_keeper")
 
 _LEASE_S = 15.0
 _NETWORK_TOLERANCE_S = 5.0
+# How long a scheduler flow-control verdict (overload ladder,
+# doc/robustness.md) stays authoritative when the scheduler attached no
+# retry-after of its own.
+_FLOW_DEFAULT_TTL_S = 1.0
 # Long-poll lap length.  The reference issues one 5s poll per demand
 # window; we split it into short laps so a fetcher observes retire()/
 # stop() within one lap instead of lingering in a blocked RPC for the
@@ -47,7 +52,10 @@ class _EnvFetcher:
     def __init__(self, keeper: "TaskGrantKeeper", env_digest: str):
         self.keeper = keeper
         self.env_digest = env_digest
-        self.queue: "queue.Queue[Grant]" = queue.Queue()
+        # Weighted-fair hand-out keyed by requestor: one make -j500
+        # must not starve the other clients on this box
+        # (doc/robustness.md, "Fairness quotas").
+        self.queue = FairGrantQueue()
         self.waiters = 0  # guarded by: self.lock
         self.lock = threading.Lock()
         self.wake = threading.Event()
@@ -58,7 +66,8 @@ class _EnvFetcher:
             daemon=True)
         self.thread.start()
 
-    def get(self, timeout_s: float) -> Optional[Grant]:
+    def get(self, timeout_s: float, client_key: str = "",
+            weight: float = 1.0) -> Optional[Grant]:
         deadline = time.monotonic() + timeout_s
         with self.lock:
             self.waiters += 1
@@ -66,12 +75,22 @@ class _EnvFetcher:
         self.wake.set()
         try:
             while True:
+                if self.retired.is_set():
+                    # Retired under us (idle sweep / stop): the closed
+                    # queue yields nothing; the keeper hands the next
+                    # call a fresh fetcher.
+                    return None
+                if self.keeper.local_only_active():
+                    # The scheduler said compile-locally; fail FAST so
+                    # the caller's local fallback starts now, not after
+                    # a 10s grant wait that cannot succeed.
+                    return None
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
-                try:
-                    g = self.queue.get(timeout=min(remaining, 0.5))
-                except queue.Empty:
+                g = self.queue.get(client_key, weight,
+                                   timeout_s=min(remaining, 0.5))
+                if g is None:
                     self.wake.set()  # fetcher may have gone idle
                     continue
                 if g.usable_until > time.monotonic():
@@ -85,19 +104,17 @@ class _EnvFetcher:
     def retire(self) -> None:
         """Stop the fetch thread and hand queued grants back.  Called
         with no waiters; late racers re-create a fresh fetcher.  The
-        loop drains again on exit: a fetch in flight during this drain
-        would otherwise strand its grants in the orphaned queue."""
+        queue is CLOSED first so a fetch landing after this point
+        parks its grants in the backlog (freed by the loop's exit
+        drain) instead of handing them to a late waiter of a dead
+        fetcher; the loop drains again on exit for exactly that case."""
         self.retired.set()
         self.wake.set()
+        self.queue.close()
         self._drain_and_free()
 
     def _drain_and_free(self) -> None:
-        stale = []
-        while True:
-            try:
-                stale.append(self.queue.get_nowait().grant_id)
-            except queue.Empty:
-                break
+        stale = [g.grant_id for g in self.queue.drain()]
         if stale:
             self.keeper._free_async(stale)
 
@@ -105,26 +122,45 @@ class _EnvFetcher:
         return (self.keeper._stopping.is_set() or self.retired.is_set())
 
     def _loop(self) -> None:
+        # Dry-scheduler pacing: bounded exponential backoff with full
+        # jitter (common/backoff.py) instead of the old fixed 0.1s lap,
+        # honoring the scheduler's retry-after when its overload ladder
+        # sent one.  Sleeps ride `retired.wait` so retirement still
+        # interrupts within one delay.
+        backoff = Backoff(initial_s=0.05, max_s=2.0)
         while not self._stopped():
             self.wake.wait(timeout=0.5)
             self.wake.clear()
             if self._stopped():
                 break
+            if self.keeper.local_only_active():
+                continue  # waiters are failing fast to local compiles
             with self.lock:
                 waiters = self.waiters
             backlog = self.queue.qsize()
             if waiters <= backlog:
                 continue  # queued grants already cover the demand
             immediate = waiters - backlog
-            grants = self.keeper._fetch(self.env_digest, immediate,
-                                        prefetch=1)
+            grants, flow, retry_after_s = self.keeper._fetch(
+                self.env_digest, immediate, prefetch=1)
             now = time.monotonic()
             for gid, location in grants:
                 self.queue.put(Grant(
                     gid, location,
                     usable_until=now + _LEASE_S - _NETWORK_TOLERANCE_S))
-            if not grants:
-                self.retired.wait(0.1)  # scheduler dry: don't hammer it
+            if grants:
+                self.keeper._note_flow(0, 0.0)
+                backoff.reset()
+                continue
+            if flow:
+                # Explicit overload verdict: record it (waiters on
+                # COMPILE_LOCALLY bail fast; REJECT paces the retry by
+                # the server's own backoff hint).
+                self.keeper._note_flow(flow, retry_after_s)
+                if flow == api.scheduler.FLOW_CONTROL_REJECT:
+                    self.retired.wait(backoff.next_delay(retry_after_s))
+                continue
+            self.retired.wait(backoff.next_delay())  # scheduler dry
         if self.retired.is_set() or self.keeper._stopping.is_set():
             # A fetch that was in flight when retire() drained may have
             # enqueued grants after that drain: free them too, or the
@@ -148,8 +184,19 @@ class TaskGrantKeeper:
         self._fetchers: Dict[str, _EnvFetcher] = {}  # guarded by: self._lock
         self._stopping = threading.Event()
         self._channel: Optional[Channel] = None  # guarded by: self._lock
+        # Last scheduler flow-control verdict and when it stops being
+        # authoritative: (FlowControlVerdict value, monotonic deadline).
+        self._flow: Tuple[int, float] = (0, 0.0)  # guarded by: self._lock
 
-    def get(self, env_digest: str, timeout_s: float = 10.0) -> Optional[Grant]:
+    def get(self, env_digest: str, timeout_s: float = 10.0,
+            client_key: str = "", weight: float = 1.0) -> Optional[Grant]:
+        """One grant for ``env_digest``, or None.  ``client_key``
+        identifies the requestor for weighted-fair hand-out (empty =
+        shared anonymous client); under an active compile-locally
+        verdict this returns None immediately so the caller's local
+        fallback starts now."""
+        if self.local_only_active():
+            return None
         now = time.monotonic()
         retire = []
         with self._lock:
@@ -167,7 +214,30 @@ class TaskGrantKeeper:
             f.last_used = now
         for r in retire:
             r.retire()
-        return f.get(timeout_s)
+        return f.get(timeout_s, client_key=client_key, weight=weight)
+
+    # -- flow-control verdict state (overload ladder) ------------------------
+
+    def _note_flow(self, flow: int, retry_after_s: float) -> None:
+        with self._lock:
+            if flow == 0:
+                self._flow = (0, 0.0)
+            else:
+                ttl = (retry_after_s if retry_after_s and retry_after_s > 0
+                       else _FLOW_DEFAULT_TTL_S)
+                self._flow = (flow, time.monotonic() + ttl)
+
+    def flow_state(self) -> Tuple[int, float]:
+        """(FlowControlVerdict value, seconds it stays authoritative);
+        (0, 0) when the last fetch saw a healthy scheduler."""
+        with self._lock:
+            flow, until = self._flow
+        remaining = until - time.monotonic()
+        return (flow, max(0.0, remaining)) if remaining > 0 else (0, 0.0)
+
+    def local_only_active(self) -> bool:
+        flow, _ = self.flow_state()
+        return flow == api.scheduler.FLOW_CONTROL_COMPILE_LOCALLY
 
     def free(self, grant_ids) -> None:
         self._free_async(list(grant_ids))
@@ -199,6 +269,7 @@ class TaskGrantKeeper:
         for f in fetchers:
             f.retired.set()
             f.wake.set()
+            f.queue.close()
         deadline = time.monotonic() + join_timeout_s
         for f in fetchers:
             f.thread.join(timeout=max(0.0, deadline - time.monotonic()))
@@ -214,6 +285,10 @@ class TaskGrantKeeper:
             return self._channel
 
     def _fetch(self, env_digest: str, immediate: int, prefetch: int):
+        """One grant poll.  Returns (grants, flow_verdict,
+        retry_after_s): flow_verdict is the scheduler's overload-ladder
+        answer (FlowControlVerdict value, 0 = none) and retry_after_s
+        its server-computed backoff hint."""
         req = api.scheduler.WaitForStartingTaskRequest(
             token=self._token,
             milliseconds_to_wait=_POLL_LAP_MS,
@@ -228,10 +303,11 @@ class TaskGrantKeeper:
                 "ytpu.SchedulerService", "WaitForStartingTask", req,
                 api.scheduler.WaitForStartingTaskResponse,
                 timeout=_POLL_LAP_MS / 1000.0 + _RPC_TIMEOUT_MARGIN_S)
-            return [(g.task_grant_id, g.servant_location)
-                    for g in resp.grants]
+            return ([(g.task_grant_id, g.servant_location)
+                     for g in resp.grants],
+                    resp.flow_control, resp.retry_after_ms / 1000.0)
         except RpcError:
-            return []
+            return [], 0, 0.0
 
     def _free_async(self, grant_ids) -> None:
         if not grant_ids:

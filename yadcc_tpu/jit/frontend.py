@@ -117,7 +117,14 @@ def offload_compile(
 def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
     import time
 
+    from ..common.backoff import Backoff
+
     deadline = time.monotonic() + timeout_s
+    # Long-poll legs are paced by the daemon (a 503 normally arrives
+    # after the full leg); fast 503s — a shedding daemon — pace through
+    # the shared backoff with the daemon's Retry-After hint instead of
+    # re-polling instantly.
+    backoff = Backoff(initial_s=0.05, max_s=2.0)
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
@@ -128,11 +135,16 @@ def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
             milliseconds_to_wait=min(_WAIT_LEG_MS,
                                      max(1, int(remaining * 1000))),
         )
+        leg_start = time.monotonic()
         resp = call_daemon(
             "POST", "/local/wait_for_jit_task",
             json_format.MessageToJson(wreq).encode(),
             timeout_s=_WAIT_LEG_MS / 1000.0 + 10.0)
         if resp.status == 503:
+            if time.monotonic() - leg_start < 0.5:
+                backoff.wait(resp.retry_after_s)
+            else:
+                backoff.reset()  # a real long-poll leg: not a spin
             continue  # still compiling
         if resp.status != 200:
             return OffloadOutcome(

@@ -22,6 +22,7 @@ from .transport import (
     RpcContext,
     RpcError,
     ServiceSpec,
+    install_fault_injector,
     method,
     register_mock_server,
     unregister_mock_server,
@@ -34,6 +35,7 @@ __all__ = [
     "RpcContext",
     "RpcError",
     "ServiceSpec",
+    "install_fault_injector",
     "method",
     "register_mock_server",
     "unregister_mock_server",

@@ -26,6 +26,7 @@ from .transport import (
     ServiceSpec,
     STATUS_TIMEOUT,
     STATUS_TRANSPORT_FAILURE,
+    apply_faults,
     decode_frame_views,
     dispatch_frame,
     encode_frame,
@@ -99,6 +100,7 @@ class GrpcServer:
 class GrpcChannel(Channel):
     def __init__(self, uri: str):
         target = uri[len("grpc://") :] if uri.startswith("grpc://") else uri
+        self._target = target
         self._channel = grpc.insecure_channel(target, options=_CHANNEL_OPTIONS)
         self._lock = threading.Lock()
         self._callables: Dict[Tuple[str, str], grpc.UnaryUnaryMultiCallable] \
@@ -119,6 +121,10 @@ class GrpcChannel(Channel):
 
     def call(self, service, method_name, request, response_cls,
              attachment=b"", timeout=None):
+        # Scenario fault seam (tools/scenarios.py): may sleep (WAN
+        # latency/jitter) or raise RpcError (flaky peer).  A no-op
+        # global read unless a simulation installed an injector.
+        apply_faults(self._target, service, method_name)
         # The socket boundary: encode_frame flattens header + meta +
         # attachment segments exactly once (a Payload attachment arrives
         # here never having been copied).

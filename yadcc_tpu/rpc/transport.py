@@ -184,6 +184,36 @@ def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> byte
 
 
 # --------------------------------------------------------------------------
+# Fault-injection seam (tools/scenarios.py).
+#
+# The hostile-world scenario matrix needs to impose WAN latency/jitter,
+# flaky peers, and slow-loris servants on the REAL wire path without
+# forking the transports.  One process-global hook, called by every
+# Channel.call implementation before the request leaves: it may sleep
+# (latency), raise RpcError (drop/refuse), or do nothing.  Production
+# never installs one — the None fast path is a single global read.
+# --------------------------------------------------------------------------
+
+# fn(target, service, method) -> None; may sleep or raise RpcError.
+_fault_injector: Optional[Callable[[str, str, str], None]] = None
+
+
+def install_fault_injector(
+        fn: Optional[Callable[[str, str, str], None]]) -> None:
+    """Install (or, with None, clear) the process-wide RPC fault hook.
+    ``target`` is the channel's destination ("host:port" or a mock
+    name), so an injector can single out one servant."""
+    global _fault_injector
+    _fault_injector = fn
+
+
+def apply_faults(target: str, service: str, method_name: str) -> None:
+    fn = _fault_injector
+    if fn is not None:
+        fn(target, service, method_name)
+
+
+# --------------------------------------------------------------------------
 # mock:// transport — in-process server registry for tests.
 # --------------------------------------------------------------------------
 
@@ -246,6 +276,7 @@ class _MockChannel(Channel):
 
     def call(self, service, method_name, request, response_cls,
              attachment=b"", timeout=None):
+        apply_faults(self._name, service, method_name)
         with _mock_lock:
             services = _mock_servers.get(self._name)
         if services is None or service not in services:

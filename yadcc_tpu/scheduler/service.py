@@ -15,6 +15,7 @@ from typing import List
 from .. import api
 from ..common.token_verifier import TokenVerifier, generate_token
 from ..rpc import RpcContext, RpcError, ServiceSpec
+from . import admission
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
 from ..utils.stagetimer import StageTimer
@@ -192,12 +193,25 @@ class SchedulerService:
         if not req.env_desc.compiler_digest:
             raise RpcError(api.scheduler.SCHEDULER_STATUS_INVALID_ARGUMENT,
                            "missing env_desc")
+        # Overload ladder (doc/robustness.md): rule BEFORE the request
+        # queues.  Shedding is never silent — LOCAL_ONLY and REJECT
+        # answer immediately with an explicit verdict (+ retry-after),
+        # SHED_OPTIONAL drops only the opportunistic prefetch.
+        decision = self.dispatcher.admission_check(
+            immediate=req.immediate_reqs or 1,
+            prefetch=req.prefetch_reqs)
+        if decision.flow != admission.FLOW_NONE:
+            resp = api.scheduler.WaitForStartingTaskResponse(
+                flow_control=decision.flow,
+                retry_after_ms=decision.retry_after_ms,
+                degradation_rung=decision.rung)
+            return resp
         grants = self.dispatcher.wait_for_starting_new_task(
             req.env_desc.compiler_digest,
             min_version=max(req.min_version, self._min_version),
             requestor=ctx.peer,
             immediate=req.immediate_reqs or 1,
-            prefetch=req.prefetch_reqs,
+            prefetch=req.prefetch_reqs if decision.prefetch_allowed else 0,
             lease_s=lease_ms / 1000.0,
             timeout_s=wait_ms / 1000.0,
         )
@@ -205,7 +219,8 @@ class SchedulerService:
             raise RpcError(
                 api.scheduler.SCHEDULER_STATUS_NO_QUOTA_AVAILABLE,
                 "no capacity for environment")
-        resp = api.scheduler.WaitForStartingTaskResponse()
+        resp = api.scheduler.WaitForStartingTaskResponse(
+            degradation_rung=decision.rung)
         for gid, location in grants:
             resp.grants.add(task_grant_id=gid, servant_location=location)
         return resp

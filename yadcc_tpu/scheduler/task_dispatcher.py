@@ -36,6 +36,7 @@ from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
 from ..utils.stagetimer import StageTimer
 from ..ops.assignment import NO_PICK
+from .admission import AdmissionConfig, AdmissionDecision, OverloadLadder
 from .policy import AssignRequest, DispatchPolicy, EnvRegistry, PoolSnapshot
 
 logger = get_logger("scheduler.dispatcher")
@@ -150,6 +151,7 @@ class TaskDispatcher:
         batch_target: int = 64,
         start_dispatch_thread: bool = True,
         pipeline_depth: int = 0,
+        admission_config: Optional[AdmissionConfig] = None,
     ):
         self._policy = policy
         self._clock = clock
@@ -211,12 +213,21 @@ class TaskDispatcher:
         self._stats = {"granted": 0, "expired_grants": 0,
                        "zombies_killed": 0}  # guarded by: self._lock
 
-        # Per-stage grant-path latency (queue-wait -> snapshot -> policy
-        # -> apply), timed with the injectable clock; surfaces in
-        # inspect() / pod_sim latency_breakdown.
+        # Per-stage grant-path latency (admission -> queue-wait ->
+        # snapshot -> policy -> apply), timed with the injectable
+        # clock; surfaces in inspect() / pod_sim latency_breakdown.
         self.stage_timer = StageTimer(
-            ("queue_wait", "snapshot", "policy", "apply",
+            ("admission", "queue_wait", "snapshot", "policy", "apply",
              "dispatch_cycle"), maxlen=16384)
+
+        # Overload ladder (scheduler admission control, doc/
+        # robustness.md): consulted by SchedulerService BEFORE a grant
+        # request queues.  Owns its own leaf lock; the dispatcher only
+        # feeds it utilization computed under the main lock, so the
+        # two locks never nest.
+        self.admission = OverloadLadder(admission_config)
+        self._cap_total = 0  # guarded by: self._lock
+        self._cap_total_at = -1.0  # guarded by: self._lock
 
         # Heartbeat staging: steady-state beats of ALREADY-REGISTERED
         # servants are recorded under a cheap leaf lock and applied in
@@ -496,6 +507,49 @@ class TaskDispatcher:
                     if g.zombie_since is None]
 
     # ------------------------------------------------------------------
+    # Admission control (overload ladder; doc/robustness.md).
+    # ------------------------------------------------------------------
+
+    def admission_check(self, immediate: int = 1,
+                        prefetch: int = 0) -> AdmissionDecision:
+        """Rule on one grant request BEFORE it queues.  Called by
+        SchedulerService.WaitForStartingTask; cheap enough for the
+        grant hot path (one cached-capacity read + a pending-list sum
+        under the lock, ladder bookkeeping under its leaf lock)."""
+        clock = self._clock
+        t0 = clock.now()
+        with self._lock:
+            util, cap = self._utilization_locked(t0)
+        decision = self.admission.decide(util, cap, immediate, prefetch,
+                                         clock.now())
+        self.stage_timer.record("admission", clock.now() - t0)
+        return decision
+
+    def _utilization_locked(self, now: float) -> Tuple[float, int]:
+        """(demand / capacity, capacity).  Demand counts every
+        outstanding grant — zombies included, they still occupy servant
+        capacity — plus queued immediate requests."""
+        cap = self._capacity_total_locked(now)
+        if cap <= 0:
+            return 0.0, 0
+        pending_imm = sum(r.immediate_left for r in self._pending)
+        return (len(self._grants) + pending_imm) / cap, cap
+
+    def _capacity_total_locked(self, now: float) -> int:
+        """Total effective pool capacity, cached for 0.5s — the
+        admission signal is coarse by design and must not put a
+        full-array reduction on every grant request at 5k req/s."""
+        if now - self._cap_total_at > 0.5 or self._cap_total_at > now:
+            self._cap_total_at = now
+            foreign = np.maximum(self._arr_load - self._arr_running, 0)
+            eff = np.minimum(self._arr_cap_rep,
+                             self._arr_nprocs - foreign)
+            eff = np.where(self._arr_accepting & self._arr_mem_ok,
+                           np.maximum(eff, 0), 0)
+            self._cap_total = int(eff.sum())
+        return self._cap_total
+
+    # ------------------------------------------------------------------
     # Timers.
     # ------------------------------------------------------------------
 
@@ -518,6 +572,11 @@ class TaskDispatcher:
                 ):
                     self._release_grant_locked(g)
             self._work.notify_all()
+            util, cap = self._utilization_locked(now)
+        # Outside the lock (the ladder's leaf lock must never nest
+        # under the main one): periodic update lets the ladder step
+        # down while no requests arrive to drive decide().
+        self.admission.update(util, cap, self._clock.now())
 
     # ------------------------------------------------------------------
     # The dispatch cycle.
@@ -1254,6 +1313,9 @@ class TaskDispatcher:
             self._thread.join(timeout=2)
 
     def inspect(self) -> dict:
+        # Ladder snapshot BEFORE the main lock: its leaf lock must not
+        # nest inside ours.
+        admission = self.admission.inspect()
         with self._lock:
             self._flush_heartbeats_locked()
             servants = {}
@@ -1284,6 +1346,9 @@ class TaskDispatcher:
                 "pending_requests": len(self._pending),
                 "stats": dict(self._stats),
                 "envs_interned": len(self._envs),
+                # Overload-ladder state (rung, signal, shed counters,
+                # recent transitions) — doc/robustness.md.
+                "admission": admission,
                 # Grant-path stage percentiles (doc/scheduler.md,
                 # "Grant-path stage budget").
                 "latency_breakdown": self.stage_timer.percentiles(),

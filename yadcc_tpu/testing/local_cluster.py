@@ -150,6 +150,7 @@ class LocalCluster:
         compiler_dirs: Optional[List[str]] = None,
         l2_engine: Optional[CacheEngine] = None,
         http_port: int = 0,
+        admission_config=None,
     ):
         # Single-process rig: self-avoidance must be off, or the
         # requesting machine (ourselves) is never eligible.  `policy`
@@ -159,7 +160,7 @@ class LocalCluster:
             policy, max_servants=max(16, n_servants), avoid_self=False)
         self.sched_dispatcher = TaskDispatcher(
             pol, max_servants=max(16, n_servants), max_envs=64,
-            batch_window_s=0.0)
+            batch_window_s=0.0, admission_config=admission_config)
         self.sched = SchedulerService(self.sched_dispatcher)
         self.sched_server = GrpcServer("127.0.0.1:0")
         self.sched_server.add_service(self.sched.spec())
@@ -218,6 +219,21 @@ class LocalCluster:
             time.sleep(0.05)
         assert len(self.sched_dispatcher.inspect()["servants"]) \
             == n_servants, "servants failed to register"
+
+    def restart_cache_server(self, down_for_s: float = 0.0) -> None:
+        """Chaos hook (tools/scenarios.py, cache-restart scenario):
+        stop the cache server's listener, optionally stay dark, then
+        serve the SAME engines again on the SAME port — a cache-server
+        crash/upgrade mid-build.  Readers and writers are expected to
+        ride it out: compiles proceed, hit rate drops, nothing errors
+        to clients."""
+        port = self.cache_server.port
+        self.cache_server.stop(grace=0)
+        if down_for_s > 0:
+            time.sleep(down_for_s)
+        self.cache_server = GrpcServer(f"127.0.0.1:{port}")
+        self.cache_server.add_service(self.cache_service.spec())
+        self.cache_server.start()
 
     def make_extra_delegate(self) -> DistributedTaskDispatcher:
         """A second delegate, as another build machine would run: own

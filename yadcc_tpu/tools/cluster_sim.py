@@ -340,10 +340,28 @@ def main() -> int:
                          " or 'byte-heavy' (uniform 128KB..1MB)")
     ap.add_argument("--compile-s", type=float, default=0.05,
                     help="fake compile duration per task (seconds)")
+    ap.add_argument("--scenario", default="",
+                    help="run a hostile-world scenario (or 'all') "
+                         "instead of the friendly sweep: one of "
+                         "wan-jitter, burst, flaky-servant, slow-loris, "
+                         "oversized-tu, cache-restart, overload-ladder "
+                         "(tools/scenarios.py, doc/robustness.md); "
+                         "exits 1 on any SLO miss")
+    ap.add_argument("--out", default="",
+                    help="with --scenario: write the JSON artifact here")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: small run; exit 1 on any failure or, "
                          "for jit, if dedup never engaged")
     args = ap.parse_args()
+    if args.scenario:
+        from . import scenarios
+
+        argv = ["--scenario", args.scenario]
+        if args.smoke:
+            argv.append("--smoke")
+        if args.out:
+            argv += ["--out", args.out]
+        return scenarios.main(argv)
     if args.smoke:
         args.tasks = min(args.tasks, 60)
         args.servants = min(args.servants, 2)

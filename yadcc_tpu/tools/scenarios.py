@@ -1,0 +1,815 @@
+"""Hostile-world scenario matrix for cluster_sim (doc/robustness.md).
+
+Every sim before this file was a friendly LAN: instant RPCs, loyal
+servants, polite clients, a cache server that never dies.  The
+reference system's core survival property — graceful degradation to
+local compilation when the cloud can't serve (yadcc/README.md:21-27) —
+only shows up under hostility, so this module makes hostility
+composable and measured:
+
+  * **fault injectors** on the real RPC wire path
+    (rpc.transport.install_fault_injector): WAN latency/jitter
+    distributions, flaky peers, slow-loris servants;
+  * **arrival processes**: steady and bursty-diurnal submission
+    schedules, per-client rates, adversarial parallelism;
+  * **mid-run chaos hooks**: cache-server restart mid-spike, servant
+    death with tasks in flight;
+  * **SLO measurement** per scenario: compile success rate with local
+    fallback counted, end-to-end latency percentiles bucketed by the
+    overload-ladder rung active at submission, fairness dispersion
+    across clients, 0 lost/hung accounting.
+
+Scenarios (``cluster_sim --scenario <name>|all``):
+
+    wan-jitter       every RPC pays a jittered WAN delay
+    burst            bursty diurnal arrivals against a small pool
+    flaky-servant    one servant's RPC surface fails ~20% of calls
+    slow-loris       one servant answers, but seconds late
+    oversized-tu     one adversarial client: 10x parallelism, megabyte
+                     TUs; fairness quotas must protect the others
+    cache-restart    the cache server restarts mid-spike
+    overload-ladder  4x-capacity grant storm straight at the
+                     scheduler; the admission ladder must walk
+                     NORMAL -> ... -> REJECT and back, no flapping
+
+Each scenario returns a JSON-able dict with its measurements, its SLO
+bounds, and a per-bound pass flag; ``run_matrix`` aggregates them into
+``artifacts/cluster_sim_hostile.json``.  ``--smoke`` shrinks the task
+counts for the CI gate in tools/ci.sh (fails on any SLO miss).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..rpc import transport
+from ..rpc.transport import RpcError, STATUS_TRANSPORT_FAILURE
+from ..scheduler.admission import (RUNG_NAMES, RUNG_NORMAL, RUNG_REJECT,
+                                   AdmissionConfig)
+
+SCENARIO_NAMES = ("wan-jitter", "burst", "flaky-servant", "slow-loris",
+                  "oversized-tu", "cache-restart", "overload-ladder")
+
+
+# --------------------------------------------------------------------------
+# Fault injectors: callables for rpc.transport.install_fault_injector.
+# --------------------------------------------------------------------------
+
+
+class WanJitter:
+    """Every matching call pays base + Exp(mean) extra milliseconds —
+    a long-haul link with queueing jitter, clipped so a pathological
+    draw can't exceed an RPC deadline."""
+
+    def __init__(self, base_ms: float = 5.0, jitter_mean_ms: float = 10.0,
+                 clip_ms: float = 80.0, seed: int = 7):
+        self._base = base_ms
+        self._jitter = jitter_mean_ms
+        self._clip = clip_ms
+        self._rng = random.Random(seed)
+
+    def __call__(self, target: str, service: str, method: str) -> None:
+        delay = min(self._base + self._rng.expovariate(1.0 / self._jitter),
+                    self._clip)
+        time.sleep(delay / 1000.0)
+
+
+class FlakyTarget:
+    """Calls to one target fail with probability p — a servant with a
+    dying NIC.  Deterministic rng: reruns reproduce."""
+
+    def __init__(self, target: str, fail_prob: float = 0.2,
+                 service: str = "ytpu.DaemonService", seed: int = 11):
+        self._target = target
+        self._p = fail_prob
+        self._service = service
+        self._rng = random.Random(seed)
+        self.injected = 0
+
+    def __call__(self, target: str, service: str, method: str) -> None:
+        if (target == self._target and service == self._service
+                and self._rng.random() < self._p):
+            self.injected += 1
+            raise RpcError(STATUS_TRANSPORT_FAILURE,
+                           "scenario: injected flaky-servant failure")
+
+
+class SlowLoris:
+    """One servant answers everything late — alive enough to hold
+    leases, slow enough to stall anyone who waits politely."""
+
+    def __init__(self, target: str, delay_s: float = 1.5,
+                 service: str = "ytpu.DaemonService"):
+        self._target = target
+        self._delay = delay_s
+        self._service = service
+
+    def __call__(self, target: str, service: str, method: str) -> None:
+        if target == self._target and service == self._service:
+            time.sleep(self._delay)
+
+
+def compose(*injectors) -> Callable[[str, str, str], None]:
+    def fn(target: str, service: str, method: str) -> None:
+        for inj in injectors:
+            inj(target, service, method)
+    return fn
+
+
+class installed_faults:
+    """Context manager installing/clearing the process fault hook."""
+
+    def __init__(self, injector) -> None:
+        self._injector = injector
+
+    def __enter__(self):
+        transport.install_fault_injector(self._injector)
+        return self._injector
+
+    def __exit__(self, *exc):
+        transport.install_fault_injector(None)
+        return False
+
+
+# --------------------------------------------------------------------------
+# Measurement plumbing shared by every scenario.
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ClientSpec:
+    """One simulated build client (a distinct requestor on the box)."""
+
+    name: str
+    pid: int
+    n_tasks: int
+    parallelism: int = 1
+    tu_bytes: int = 256
+    # Seconds to sleep between submissions per worker thread; callables
+    # get (task_index, elapsed_s) — bursty schedules live here.
+    inter_arrival: object = 0.0
+    adversary: bool = False
+
+
+@dataclass
+class _Counts:
+    submitted: int = 0
+    ok_remote: int = 0
+    local_fallback: int = 0
+    hard_failures: int = 0
+    lost_or_hung: int = 0
+    latencies: List[float] = field(default_factory=list)
+    lat_when: List[float] = field(default_factory=list)
+
+
+class _RungMonitor:
+    """Samples the scheduler's admission rung on a short cadence; the
+    timeline buckets client latencies per rung and proves ladder
+    transitions (reach REJECT, recover, no flapping)."""
+
+    def __init__(self, dispatcher, period_s: float = 0.05):
+        self._d = dispatcher
+        self._period = period_s
+        self._stop = threading.Event()
+        # (elapsed_s, rung) samples.
+        self.samples: List[tuple] = []  # guarded by: self._lock
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="rung-monitor", daemon=True)
+
+    def start(self):
+        self._t0 = time.monotonic()
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self._period):
+            rung = self._d.admission.rung()
+            with self._lock:
+                self.samples.append((time.monotonic() - self._t0, rung))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+    def rung_at(self, elapsed: float) -> int:
+        with self._lock:
+            rung = RUNG_NORMAL
+            for t, r in self.samples:
+                if t > elapsed:
+                    break
+                rung = r
+            return rung
+
+    def max_rung(self) -> int:
+        with self._lock:
+            return max((r for _, r in self.samples), default=RUNG_NORMAL)
+
+
+def _pctl(values_ms: List[float], q: float) -> Optional[float]:
+    if not values_ms:
+        return None
+    return round(float(np.percentile(np.array(values_ms), q)), 1)
+
+
+def _check_slo(measured: dict, slo: dict) -> dict:
+    """{bound_name: ok} for every bound; missing measurements fail
+    loudly rather than pass silently."""
+    checks = {}
+    for key, bound in slo.items():
+        if key.endswith("_min"):
+            v = measured.get(key[: -len("_min")])
+            checks[key] = v is not None and v >= bound
+        elif key.endswith("_max"):
+            v = measured.get(key[: -len("_max")])
+            checks[key] = v is not None and v <= bound
+        else:
+            checks[key] = False
+    return checks
+
+
+# --------------------------------------------------------------------------
+# The full-stack hostile world runner (every scenario except the raw
+# overload-ladder storm drives the REAL client -> delegate -> scheduler
+# -> servant -> cache pipeline).
+# --------------------------------------------------------------------------
+
+
+def _run_world(
+    *,
+    clients: List[ClientSpec],
+    servants: int = 2,
+    concurrency: int = 2,
+    compile_s: float = 0.02,
+    cache_control: int = 1,
+    injector_factory=None,      # (cluster) -> injector or None
+    mid_run=None,               # (cluster, counts_so_far) -> None
+    mid_run_after_frac: float = 0.4,
+    task_timeout_s: float = 60.0,
+    retries: int = 2,
+    admission_config: Optional[AdmissionConfig] = None,
+) -> dict:
+    from ..common import compress
+    from ..common.hashing import digest_bytes, digest_file
+    from ..daemon.local.cxx_task import CxxCompilationTask
+    from ..testing import LocalCluster, make_fake_compiler
+
+    tmp = Path(tempfile.mkdtemp(prefix="chaos_"))
+    compiler = make_fake_compiler(str(tmp / "bin"), compile_s=compile_s)
+    compiler_digest = digest_file(compiler)
+    cluster = LocalCluster(tmp, n_servants=servants, policy="greedy_cpu",
+                           servant_concurrency=concurrency,
+                           compiler_dirs=[str(tmp / "bin")],
+                           admission_config=admission_config)
+    monitor = _RungMonitor(cluster.sched_dispatcher).start()
+    counts: Dict[str, _Counts] = {c.name: _Counts() for c in clients}
+    counts_lock = threading.Lock()
+    total_tasks = sum(c.n_tasks for c in clients)
+    done_total = [0]
+    mid_run_fired = [False]
+    t0 = time.monotonic()
+
+    def make_task(spec: ClientSpec, i: int) -> CxxCompilationTask:
+        filler = (b"/* %d */ " % i) * max(1, spec.tu_bytes // 10)
+        src = (f"// {spec.name} tu{i}\n".encode() + filler
+               + f"\nint f_{spec.pid}_{i}() {{ return {i}; }}\n".encode())
+        return CxxCompilationTask(
+            requestor_pid=spec.pid,
+            source_path=f"/src/{spec.name}/tu{i}.cc",
+            source_digest=digest_bytes(src),
+            invocation_arguments="-O2",
+            cache_control=cache_control,
+            compiler_digest=compiler_digest,
+            compressed_source=compress.compress(src),
+        )
+
+    def submit_one(spec: ClientSpec, i: int) -> None:
+        t_sub = time.monotonic()
+        outcome = "lost"
+        for _ in range(1 + retries):
+            tid = cluster.delegate.queue_task(make_task(spec, i))
+            result = cluster.delegate.wait_for_task(tid, task_timeout_s)
+            cluster.delegate.free_task(tid)
+            if result is None:
+                outcome = "lost"       # hung past the generous timeout
+                break
+            if result.exit_code == 0:
+                outcome = "remote"
+                break
+            if result.exit_code > 0:
+                outcome = "hard"       # deterministic compile failure
+                break
+            outcome = "infra"          # retry, then fall back local
+        if outcome == "infra":
+            # The survival contract (yadcc/README.md:21-27): the client
+            # compiles locally when the cloud can't serve.  Local CPU
+            # time is simulated; the SUBMISSION still succeeded.
+            time.sleep(compile_s)
+            outcome = "local"
+        dt_ms = (time.monotonic() - t_sub) * 1000.0
+        with counts_lock:
+            c = counts[spec.name]
+            c.submitted += 1
+            c.latencies.append(dt_ms)
+            c.lat_when.append(t_sub - t0)
+            if outcome == "remote":
+                c.ok_remote += 1
+            elif outcome == "local":
+                c.local_fallback += 1
+            elif outcome == "hard":
+                c.hard_failures += 1
+            else:
+                c.lost_or_hung += 1
+            done_total[0] += 1
+            fire_mid = (mid_run is not None and not mid_run_fired[0]
+                        and done_total[0] >= total_tasks
+                        * mid_run_after_frac)
+            if fire_mid:
+                mid_run_fired[0] = True
+        if fire_mid:
+            mid_run(cluster, dict(done=done_total[0]))
+
+    def client_worker(spec: ClientSpec, worker_idx: int, todo: List[int]):
+        while True:
+            with counts_lock:
+                if not todo:
+                    return
+                i = todo.pop()
+            delay = spec.inter_arrival
+            if callable(delay):
+                delay = delay(i, time.monotonic() - t0)
+            if delay:
+                time.sleep(delay)
+            submit_one(spec, i)
+
+    injector = injector_factory(cluster) if injector_factory else None
+    try:
+        with installed_faults(injector):
+            threads = []
+            for spec in clients:
+                todo = list(range(spec.n_tasks))
+                for w in range(spec.parallelism):
+                    t = threading.Thread(
+                        target=client_worker, args=(spec, w, todo),
+                        name=f"client-{spec.name}-{w}", daemon=True)
+                    threads.append(t)
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+        wall = time.monotonic() - t0
+    finally:
+        monitor.stop()
+        cluster.stop()
+
+    all_lat = [l for c in counts.values() for l in c.latencies]
+    all_when = [w for c in counts.values() for w in c.lat_when]
+    per_rung: Dict[str, List[float]] = {}
+    for lat, when in zip(all_lat, all_when):
+        per_rung.setdefault(RUNG_NAMES[monitor.rung_at(when)],
+                            []).append(lat)
+    total = sum(c.submitted for c in counts.values())
+    survived = sum(c.ok_remote + c.local_fallback for c in counts.values())
+    out = {
+        "tasks": total,
+        "wall_seconds": round(wall, 2),
+        "ok_remote": sum(c.ok_remote for c in counts.values()),
+        "local_fallback": sum(c.local_fallback for c in counts.values()),
+        "hard_failures": sum(c.hard_failures for c in counts.values()),
+        "lost_or_hung": sum(c.lost_or_hung for c in counts.values()),
+        "compile_success_rate": round(survived / max(1, total), 4),
+        "latency_p50_ms": _pctl(all_lat, 50),
+        "latency_p99_ms": _pctl(all_lat, 99),
+        "latency_p99_ms_by_rung": {k: _pctl(v, 99)
+                                   for k, v in per_rung.items()},
+        "max_rung": RUNG_NAMES[monitor.max_rung()],
+        "per_client": {
+            name: {"submitted": c.submitted, "ok_remote": c.ok_remote,
+                   "local_fallback": c.local_fallback,
+                   "lost_or_hung": c.lost_or_hung}
+            for name, c in counts.items()
+        },
+    }
+    return out
+
+
+# --------------------------------------------------------------------------
+# Scenario definitions.
+# --------------------------------------------------------------------------
+
+
+def _steady_clients(n_clients: int, tasks_each: int,
+                    parallelism: int = 2) -> List[ClientSpec]:
+    return [ClientSpec(name=f"c{i}", pid=1000 + i, n_tasks=tasks_each,
+                       parallelism=parallelism)
+            for i in range(n_clients)]
+
+
+def _servant_target(cluster, idx: int) -> str:
+    return f"127.0.0.1:{cluster.servants[idx].server.port}"
+
+
+def _scn_wan_jitter(smoke: bool) -> dict:
+    tasks = 20 if smoke else 60
+    out = _run_world(
+        clients=_steady_clients(2, tasks),
+        compile_s=0.01,
+        injector_factory=lambda cluster: WanJitter(
+            base_ms=5.0, jitter_mean_ms=10.0, clip_ms=80.0),
+    )
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "latency_p99_ms_max": 20_000.0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_burst(smoke: bool) -> dict:
+    tasks = 24 if smoke else 80
+
+    def diurnal(i: int, elapsed: float) -> float:
+        # 2s cycle: a quiet half (trickle) and a spike half (burst).
+        return 0.12 if (elapsed % 2.0) < 1.0 else 0.0
+
+    clients = [ClientSpec(name=f"c{i}", pid=1100 + i, n_tasks=tasks,
+                          parallelism=3, inter_arrival=diurnal)
+               for i in range(2)]
+    out = _run_world(clients=clients, compile_s=0.01)
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "latency_p99_ms_max": 20_000.0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_flaky_servant(smoke: bool) -> dict:
+    tasks = 20 if smoke else 60
+    holder = {}
+
+    def factory(cluster):
+        holder["inj"] = FlakyTarget(_servant_target(cluster, 0),
+                                    fail_prob=0.25)
+        return holder["inj"]
+
+    out = _run_world(
+        clients=_steady_clients(2, tasks),
+        compile_s=0.01,
+        injector_factory=factory,
+        retries=3,
+    )
+    out["injected_failures"] = holder["inj"].injected
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "injected_failures_min": 1,  # the storm actually happened
+           "latency_p99_ms_max": 30_000.0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_slow_loris(smoke: bool) -> dict:
+    tasks = 12 if smoke else 36
+    out = _run_world(
+        clients=_steady_clients(2, tasks),
+        compile_s=0.01,
+        servants=2,
+        injector_factory=lambda cluster: SlowLoris(
+            _servant_target(cluster, 0), delay_s=1.2),
+        task_timeout_s=90.0,
+    )
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "latency_p99_ms_max": 60_000.0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_oversized_tu(smoke: bool) -> dict:
+    """One adversary: 10x the parallelism, megabyte TUs, cache
+    disabled so every submission needs a grant.  Weighted-fair
+    admission must hold every victim at >= 80% of its fair share."""
+    victim_tasks = 10 if smoke else 30
+    adv_tasks = victim_tasks * 10
+    clients = [
+        ClientSpec(name="adversary", pid=666, n_tasks=adv_tasks,
+                   parallelism=10, tu_bytes=1 << 20, adversary=True),
+    ] + [
+        ClientSpec(name=f"victim{i}", pid=2000 + i, n_tasks=victim_tasks,
+                   parallelism=1, tu_bytes=512)
+        for i in range(3)
+    ]
+    out = _run_world(
+        clients=clients,
+        servants=2, concurrency=2,
+        compile_s=0.05,
+        cache_control=0,  # force the grant path for every task
+        # Isolate fairness from admission: the ladder must not convert
+        # the adversary's storm into LOCAL_ONLY verdicts here.
+        admission_config=AdmissionConfig(up_thresholds=(1e9, 1e9, 1e9)),
+        task_timeout_s=120.0,
+    )
+    # Fairness dispersion: while the adversary saturates, victims each
+    # submit serially — their throughput is their share.  Compare each
+    # victim's remote-compile rate against the no-contention ideal of
+    # one fair share of servant capacity.
+    per = out["per_client"]
+    victims = {k: v for k, v in per.items() if k != "adversary"}
+    n_clients = len(per)
+    fair_share = out["tasks"] / n_clients
+    # A victim that finished all its tasks had its demand met — demand
+    # below fair share caps the achievable "share".
+    shares = {}
+    for k, v in victims.items():
+        demand = v["submitted"]
+        served = v["ok_remote"] + v["local_fallback"]
+        shares[k] = round(served / min(fair_share, demand), 3)
+    out["fairness"] = {
+        "fair_share_tasks": round(fair_share, 1),
+        "victim_share_ratio": shares,
+        "min_victim_share_ratio": round(min(shares.values()), 3),
+        "adversary_served": per["adversary"]["ok_remote"]
+        + per["adversary"]["local_fallback"],
+    }
+    out["min_victim_share_ratio"] = out["fairness"][
+        "min_victim_share_ratio"]
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "min_victim_share_ratio_min": 0.8}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_cache_restart(smoke: bool) -> dict:
+    tasks = 20 if smoke else 60
+
+    def mid_run(cluster, progress):
+        cluster.restart_cache_server(down_for_s=0.5)
+
+    out = _run_world(
+        clients=_steady_clients(2, tasks),
+        compile_s=0.01,
+        mid_run=mid_run,
+        mid_run_after_frac=0.3,
+        retries=3,
+    )
+    slo = {"compile_success_rate_min": 0.99, "lost_or_hung_max": 0,
+           "latency_p99_ms_max": 30_000.0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_overload_ladder(smoke: bool) -> dict:
+    """4x-capacity grant storm straight at the real SchedulerService
+    over loopback gRPC.  Asserts the tentpole contract: the ladder
+    climbs to REJECT (fast, explicit verdicts with server-computed
+    retry-after), recovers to NORMAL once the storm ends, and does not
+    flap.  Storm clients behave like real delegates: they honor
+    retry-after, and when retries exhaust their budget they fall back
+    to local compilation — every task resolves."""
+    from .. import api
+    from ..jit.env import local_jit_environment
+    from ..rpc import Channel
+    from ..testing import LocalCluster
+
+    tasks_per_thread = 3 if smoke else 6
+    n_threads = 16  # vs pool capacity 4: the synthetic 4x overload
+    cfg = AdmissionConfig(
+        up_thresholds=(1.2, 2.0, 3.0),
+        up_dwell_s=0.15, down_dwell_s=0.6,
+        demand_window_s=1.5,
+        retry_after_base_ms=100, retry_after_max_ms=800)
+    tmp = Path(tempfile.mkdtemp(prefix="ladder_"))
+    cluster = LocalCluster(tmp, n_servants=2, policy="greedy_cpu",
+                           servant_concurrency=2, admission_config=cfg)
+    monitor = _RungMonitor(cluster.sched_dispatcher, period_s=0.03).start()
+    env = local_jit_environment("cpu").digest
+
+    # Production runs a 1s expiration sweep (scheduler/entry.py) that
+    # also re-evaluates the ladder; the rig needs one for the ladder to
+    # step down while the pool is quiet.
+    sweep_stop = threading.Event()
+
+    def sweeper():
+        while not sweep_stop.wait(0.25):
+            cluster.sched_dispatcher.on_expiration_timer()
+
+    threading.Thread(target=sweeper, name="ladder-sweep",
+                     daemon=True).start()
+
+    lock = threading.Lock()
+    calls: List[dict] = []
+    results = {"remote": 0, "local": 0, "lost": 0}
+
+    def wait_call(chan, wait_ms: int):
+        req = api.scheduler.WaitForStartingTaskRequest(
+            token="", milliseconds_to_wait=wait_ms, immediate_reqs=1,
+            next_keep_alive_in_ms=5000)
+        req.env_desc.compiler_digest = env
+        t0 = time.monotonic()
+        flow, rung, retry_after_s, grants = 0, 0, 0.0, []
+        try:
+            resp, _ = chan.call(
+                "ytpu.SchedulerService", "WaitForStartingTask", req,
+                api.scheduler.WaitForStartingTaskResponse,
+                timeout=wait_ms / 1000.0 + 2.0)
+            flow = resp.flow_control
+            rung = resp.degradation_rung
+            retry_after_s = resp.retry_after_ms / 1000.0
+            grants = [g.task_grant_id for g in resp.grants]
+        except RpcError:
+            pass  # NO_QUOTA refusal after the wait window: a dry answer
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        with lock:
+            calls.append({"ms": wall_ms, "flow": flow, "rung": rung})
+        return grants, flow, retry_after_s
+
+    def storm_thread(idx: int):
+        chan = Channel(cluster.sched_uri)
+        for _ in range(tasks_per_thread):
+            deadline = time.monotonic() + 3.0
+            outcome = None
+            while outcome is None:
+                grants, flow, retry_after_s = wait_call(chan, wait_ms=300)
+                if grants:
+                    time.sleep(0.3)  # the "compile" holds the grant
+                    chan.call("ytpu.SchedulerService", "FreeTask",
+                              api.scheduler.FreeTaskRequest(
+                                  token="", task_grant_ids=grants),
+                              api.scheduler.FreeTaskResponse, timeout=5.0)
+                    outcome = "remote"
+                elif flow == 1:         # FLOW_CONTROL_COMPILE_LOCALLY
+                    outcome = "local"
+                elif time.monotonic() > deadline:
+                    # Retry budget exhausted under REJECT: the survival
+                    # contract says compile locally, not hang.
+                    outcome = "local"
+                elif flow == 2:         # FLOW_CONTROL_REJECT
+                    time.sleep(min(retry_after_s or 0.1, 0.8))
+            with lock:
+                results[outcome] += 1
+
+    threads = [threading.Thread(target=storm_thread, args=(i,),
+                                name=f"storm-{i}", daemon=True)
+               for i in range(n_threads)]
+    t_storm = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        if t.is_alive():
+            with lock:
+                results["lost"] += 1
+    storm_s = time.monotonic() - t_storm
+
+    # Recovery: a low-rate probe (one delegate still poking the
+    # scheduler) while the sweep re-evaluates; the ladder must walk
+    # back down to NORMAL with hysteresis.
+    probe = Channel(cluster.sched_uri)
+    recovered_at = None
+    recovery_deadline = time.monotonic() + (12.0 if smoke else 20.0)
+    try:
+        while time.monotonic() < recovery_deadline:
+            grants, _, _ = wait_call(probe, wait_ms=50)
+            if grants:
+                probe.call("ytpu.SchedulerService", "FreeTask",
+                           api.scheduler.FreeTaskRequest(
+                               token="", task_grant_ids=grants),
+                           api.scheduler.FreeTaskResponse, timeout=5.0)
+            if cluster.sched_dispatcher.admission.rung() == RUNG_NORMAL:
+                recovered_at = time.monotonic() - t_storm
+                break
+            time.sleep(0.4)
+    finally:
+        transitions = cluster.sched_dispatcher.admission.transitions()
+        admission = cluster.sched_dispatcher.admission.inspect()
+        sweep_stop.set()
+        monitor.stop()
+        cluster.stop()
+
+    reject_ms = [c["ms"] for c in calls if c["flow"] == 2]
+    local_ms = [c["ms"] for c in calls if c["flow"] == 1]
+    total_tasks = n_threads * tasks_per_thread
+    survived = results["remote"] + results["local"]
+    per_rung: Dict[str, List[float]] = {}
+    for c in calls:
+        per_rung.setdefault(RUNG_NAMES[c["rung"]], []).append(c["ms"])
+    out = {
+        "tasks": total_tasks,
+        "storm_threads": n_threads,
+        "pool_capacity": 4,
+        "overload_factor": 4.0,
+        "storm_seconds": round(storm_s, 2),
+        "ok_remote": results["remote"],
+        "local_fallback": results["local"],
+        "hard_failures": 0,
+        "lost_or_hung": results["lost"]
+        + (total_tasks - survived - results["lost"]),
+        "compile_success_rate": round(survived / total_tasks, 4),
+        "grant_calls": len(calls),
+        "reject_verdicts": len(reject_ms),
+        "local_only_verdicts": len(local_ms),
+        "reject_p99_ms": _pctl(reject_ms, 99),
+        "latency_p99_ms_by_rung": {k: _pctl(v, 99)
+                                   for k, v in per_rung.items()},
+        "max_rung": RUNG_NAMES[monitor.max_rung()],
+        "reached_reject": int(monitor.max_rung() >= RUNG_REJECT),
+        "recovered_to_normal": int(recovered_at is not None),
+        "recovery_seconds_after_storm": (
+            round(recovered_at - storm_s, 2)
+            if recovered_at is not None else None),
+        "rung_transitions": admission["transitions"],
+        "transition_count": len(transitions),
+        "admission_stats": admission["stats"],
+    }
+    slo = {
+        "compile_success_rate_min": 0.99,
+        "lost_or_hung_max": 0,
+        "reached_reject_min": 1,
+        "recovered_to_normal_min": 1,
+        # Hysteresis: one climb + one descent, small slack — a flapping
+        # ladder would blow straight through this.
+        "transition_count_max": 10,
+        "reject_verdicts_min": 1,
+        # A REJECT answer is an immediate verdict, not a queue wait.
+        "reject_p99_ms_max": 250.0,
+    }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def run_scenario(name: str, smoke: bool = False) -> dict:
+    fn = {
+        "wan-jitter": _scn_wan_jitter,
+        "burst": _scn_burst,
+        "flaky-servant": _scn_flaky_servant,
+        "slow-loris": _scn_slow_loris,
+        "oversized-tu": _scn_oversized_tu,
+        "cache-restart": _scn_cache_restart,
+        "overload-ladder": _scn_overload_ladder,
+    }[name]
+    out = fn(smoke)
+    out["scenario"] = name
+    out["smoke"] = smoke
+    out["slo_ok"] = all(out["slo_checks"].values())
+    return out
+
+
+def run_matrix(names=None, smoke: bool = False) -> dict:
+    scenarios = {}
+    for name in names or SCENARIO_NAMES:
+        scenarios[name] = run_scenario(name, smoke=smoke)
+    return {
+        "harness": "cluster_sim_hostile",
+        "smoke": smoke,
+        "scenarios": scenarios,
+        "all_slo_ok": all(s["slo_ok"] for s in scenarios.values()),
+    }
+
+
+def quick_hostile_metrics() -> dict:
+    """bench.py's riding-along fields: the REJECT-verdict p99 from a
+    smoke overload ladder and the survival rate from a smoke
+    flaky-servant run."""
+    ladder = run_scenario("overload-ladder", smoke=True)
+    flaky = run_scenario("flaky-servant", smoke=True)
+    return {
+        "overload_reject_p99_ms": ladder["reject_p99_ms"],
+        "survival_compile_success_rate": flaky["compile_success_rate"],
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser("ytpu-scenarios")
+    ap.add_argument("--scenario", default="all",
+                    help="one of %s or 'all'" % (SCENARIO_NAMES,))
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default="",
+                    help="write the matrix artifact here")
+    args = ap.parse_args(argv)
+    names = (SCENARIO_NAMES if args.scenario == "all"
+             else (args.scenario,))
+    out = run_matrix(names, smoke=args.smoke)
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+    return 0 if out["all_slo_ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
