@@ -1,16 +1,24 @@
-"""ytpu-analyze: the static concurrency/jit-discipline tier.
+"""ytpu-analyze: the static analysis tier (concurrency, jit,
+untrusted-taint, resource-lifecycle, wire-compat).
 
-Three layers:
+Four layers:
 
 1. Fixture snippets per rule family — a seeded violation is caught
    (true positive), the disciplined twin is not (true negative), and a
    ``# ytpu: allow(<rule>)  # reason`` suppression is honored.
 2. Self-check: the analyzer runs over the real ``yadcc_tpu`` package
    and must report ZERO unsuppressed findings — the same gate
-   ``make lint`` / tools/ci.sh enforces on every push.
-3. Regression tests for the genuine defects the analyzer surfaced in
-   this round (execution-engine admission I/O under the engine lock,
-   delegate-dispatcher stats races, Bloom replica salt/filter tear).
+   ``make lint`` / tools/ci.sh enforces on every push — with
+   has-teeth assertions that the trust boundary really is annotated
+   (>=10 sanitizers, sources declared in every intake module).
+3. Infra: --baseline round-trip, --stats, the content-hash result
+   cache (hits, invalidation, corruption), the wire-compat golden
+   (a deliberately renumbered proto field fails lint).
+4. Regression tests for the genuine defects the analyzer surfaced —
+   v1: engine admission I/O under the engine lock, dispatcher stats
+   races, Bloom salt/filter tear; v2: the unbounded Content-Length
+   buffer, the unclamped quota wait, workspace/socket/subprocess
+   leaks on exception paths.
 """
 
 from __future__ import annotations
@@ -361,7 +369,10 @@ name = "x # not a comment"
 def _package_config():
     ranks = minitoml.load_path(
         os.path.join(PKG_DIR, "analysis", "lock_hierarchy.toml"))["rank"]
-    return AnalyzerConfig(lock_ranks={k: int(v) for k, v in ranks.items()})
+    return AnalyzerConfig(
+        lock_ranks={k: int(v) for k, v in ranks.items()},
+        wire_golden=os.path.join(PKG_DIR, "analysis",
+                                 "wire_golden.json"))
 
 
 def test_self_check_package_is_clean():
@@ -410,11 +421,11 @@ def test_cli_exit_codes_and_json(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "yadcc_tpu.analysis", str(tmp_path),
-         "--json", str(report)],
+         "--no-cache", "--json", str(report)],
         cwd=REPO_ROOT, env=env, capture_output=True, text=True)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     data = json.loads(report.read_text())
-    assert data["version"] == 1
+    assert data["version"] == 2
     assert data["stats"]["findings"] == 1
     assert data["findings"][0]["rule"] == "block-under-lock"
 
@@ -538,3 +549,721 @@ def test_cache_reader_snapshots_salt_with_filter():
     want = np.array([flt.may_contain(k) for k in keys])
     assert (got == want).all(), \
         "membership probed with torn salt/filter pair"
+
+
+# ---------------------------------------------------------------------------
+# untrusted-taint (v2)
+# ---------------------------------------------------------------------------
+
+
+TAINT_SNIPPET = """
+import subprocess
+
+
+def check_cap(n):  # ytpu: sanitizes(size-cap)
+    return min(int(n), 1000)
+
+
+def derive_key(k):  # ytpu: sanitizes(key-domain)
+    return "ns-" + str(k)
+
+
+def handle(self, req, body):  # ytpu: untrusted(req, body)
+    data = self.rfile.read(req.length)
+    self.cache.async_write(req.key, data)
+    open(req.path)
+    subprocess.run([req.cmd])
+    return data
+
+
+def handle_clean(self, req, body):  # ytpu: untrusted(req, body)
+    data = self.rfile.read(check_cap(req.length))
+    self.cache.async_write(derive_key(req.key), data)
+    data2 = self.rfile.read(min(req.length, 4096))
+    return data, data2
+
+
+def handle_suppressed(self, req):  # ytpu: untrusted(req)
+    return self.rfile.read(req.length)  # ytpu: allow(taint-alloc)  # fixture: bounded upstream by the transport frame cap
+"""
+
+
+def test_taint_family(tmp_path):
+    findings, _ = run_snippet(tmp_path, TAINT_SNIPPET, subdir="daemon")
+    assert len(live(findings, "taint-alloc")) == 1
+    assert len(live(findings, "taint-cache-key")) == 1
+    assert len(live(findings, "taint-path")) == 1
+    assert len(live(findings, "taint-argv")) == 1
+    # handle_clean contributes nothing; the suppression is honored.
+    sup = [f for f in findings if f.suppressed]
+    assert any(f.rule == "taint-alloc" for f in sup)
+
+
+def test_taint_interprocedural_wait(tmp_path):
+    findings, _ = run_snippet(tmp_path, """
+def intake(self, req):  # ytpu: untrusted(req)
+    park(req.task, req.ms / 1000.0)
+    park(req.task, min(req.ms, 10_000) / 1000.0)
+
+
+def park(task, timeout_s):
+    return task, timeout_s
+""", subdir="daemon")
+    tw = live(findings, "taint-wait")
+    assert len(tw) == 1 and tw[0].line == 3  # the unclamped call only
+
+
+def test_taint_through_method_receiver(tmp_path):
+    # `self.headers.get(...)` is as untrusted as self.headers — the
+    # Content-Length defect shape (do_POST).
+    findings, _ = run_snippet(tmp_path, """
+class H:
+    def do_POST(self):  # ytpu: untrusted(self.headers, self.rfile)
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+""", subdir="daemon")
+    assert len(live(findings, "taint-alloc")) == 1
+
+
+def test_taint_statement_form_sanitizer(tmp_path):
+    # `self._verify(req)` as a bare statement blesses `req` from there
+    # on — the servant token-gate idiom, with a size-cap tag here so
+    # the effect is observable on an alloc sink.
+    findings, _ = run_snippet(tmp_path, """
+def validate(req):  # ytpu: sanitizes(size-cap)
+    if req.length > 1000:
+        raise ValueError
+
+
+def handler(self, req):  # ytpu: untrusted(req)
+    validate(req)
+    return self.rfile.read(req.length)
+""", subdir="daemon")
+    assert not live(findings, "taint-alloc")
+
+
+def test_taint_interprocedural_sanitizer_chain(tmp_path):
+    # Taint crosses a call edge into a helper, where the sanitizer
+    # finally clears it — and an unsanitized twin still fires.
+    findings, _ = run_snippet(tmp_path, """
+def intake(self, req, attachment):  # ytpu: untrusted(req, attachment)
+    stage(self, attachment)
+    stage_raw(self, attachment)
+
+
+def stage(self, blob):
+    data = unpack(blob)
+    return self.rfile.read(len(data))
+
+
+def stage_raw(self, blob):
+    return self.rfile.read(blob.length)
+
+
+def unpack(blob):  # ytpu: sanitizes(size-cap)
+    return blob
+""", subdir="daemon")
+    ta = live(findings, "taint-alloc")
+    assert len(ta) == 1
+    assert "stage_raw" in ta[0].message  # the unsanitized leg only
+
+
+def test_taint_registry(tmp_path):
+    findings, _ = run_snippet(tmp_path, """
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TaskType:
+    kind: str
+    make_task: object
+
+
+def capped(att):  # ytpu: sanitizes(size-cap)
+    return att
+
+
+def make_good_task(msg, att):
+    return capped(att)
+
+
+def make_bad_task(msg, att):
+    return att
+
+
+GOOD = TaskType(kind="good", make_task=lambda m, a: make_good_task(m, a))
+BAD = TaskType(kind="bad", make_task=lambda m, a: make_bad_task(m, a))
+""", subdir="daemon")
+    tr = live(findings, "taint-registry")
+    assert len(tr) == 1 and "'bad'" in tr[0].message
+
+
+# ---------------------------------------------------------------------------
+# resource lifecycle (v2)
+# ---------------------------------------------------------------------------
+
+
+LIFECYCLE_SNIPPET = """
+import subprocess
+
+
+def tp_leak(path):
+    fp = open(path)
+    fp.seek(0)
+
+
+def tp_exc_path(path):
+    fp = open(path)
+    data = parse(fp)
+    fp.close()
+    return data
+
+
+def tn_with(path):
+    with open(path) as fp:
+        return parse(fp)
+
+
+def tn_finally(path):
+    fp = open(path)
+    try:
+        return parse(fp)
+    finally:
+        fp.close()
+
+
+def tn_escape(path):
+    fp = open(path)
+    return fp
+
+
+def tn_store(self, path):
+    self._fp = open(path)
+
+
+def tn_immediate_close(path):
+    fp = open(path)
+    fp.close()
+
+
+def sup_known(path):
+    fp = open(path)  # ytpu: allow(lifecycle-exc-path)  # fixture: parse cannot raise here
+    data = parse(fp)
+    fp.close()
+    return data
+
+
+def parse(fp):
+    return fp
+"""
+
+
+def test_lifecycle_family(tmp_path):
+    findings, _ = run_snippet(tmp_path, LIFECYCLE_SNIPPET,
+                              subdir="daemon")
+    leaks = live(findings, "lifecycle-leak")
+    assert len(leaks) == 1 and leaks[0].line == 6
+    exc = live(findings, "lifecycle-exc-path")
+    assert len(exc) == 1 and exc[0].line == 11
+    assert len([f for f in findings if f.suppressed]) == 1
+
+
+def test_lifecycle_annotated_receiver(tmp_path):
+    # The servant Queue-handler shape: `task.prepare(...)` acquires a
+    # workspace on the receiver; releasing only on happy-path branches
+    # is a finding, an except-handler release (the fixed shape) is not.
+    findings, _ = run_snippet(tmp_path, """
+class Task:
+    def prepare(self, src):  # ytpu: acquires(workspace)
+        self.workspace = object()
+
+
+def queue_leaky(self, req, att):
+    task = Task()
+    task.prepare(att)
+    tid = self.engine.try_queue_task(task.digest)
+    if tid is None:
+        task.workspace.remove()
+        raise RuntimeError("saturated")
+    return tid
+
+
+def queue_fixed(self, req, att):
+    task = Task()
+    try:
+        task.prepare(att)
+        tid = self.engine.try_queue_task(task.digest)
+        if tid is None:
+            raise RuntimeError("saturated")
+    except BaseException:
+        task.workspace.remove()
+        raise
+    return tid
+""", subdir="daemon")
+    exc = live(findings, "lifecycle-exc-path")
+    assert len(exc) == 1 and exc[0].line == 9  # queue_leaky's prepare
+
+
+def test_lifecycle_view_escape(tmp_path):
+    findings, _ = run_snippet(tmp_path, """
+def tp_escaping_view(n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    return view
+
+
+def tn_view_of_param(data):
+    view = memoryview(data)
+    return view
+
+
+def tn_local_use(n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    return bytes(view)
+""", subdir="daemon")
+    ve = live(findings, "lifecycle-view-escape")
+    assert len(ve) == 1 and ve[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# wire-compat (v2)
+# ---------------------------------------------------------------------------
+
+
+def _write_fixture_api(tmp_path, *, gen_number=1, gen_field="a",
+                       proto_number=1):
+    """A tiny pkg/api tree: widget.proto + a gen module whose embedded
+    descriptor the test controls (built with descriptor_pb2, exactly
+    like protoc would serialize it)."""
+    from google.protobuf import descriptor_pb2
+
+    pkg = tmp_path / "pkg"
+    protos = pkg / "api" / "protos"
+    gen = pkg / "api" / "gen"
+    protos.mkdir(parents=True)
+    gen.mkdir(parents=True)
+    (protos / "widget.proto").write_text(textwrap.dedent(f"""\
+        syntax = "proto3";
+        package fix;
+        message WidgetMsg {{
+          string {gen_field if gen_field != 'a' else 'a'} = {proto_number};
+          repeated WidgetPart parts = 2;
+        }}
+        message WidgetPart {{
+          uint32 pos = 1;
+        }}
+        """))
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="widget.proto", package="fix", syntax="proto3")
+    m = fd.message_type.add(name="WidgetMsg")
+    m.field.add(name=gen_field, number=gen_number, label=1, type=9)
+    m.field.add(name="parts", number=2, label=3, type=11,
+                type_name=".fix.WidgetPart")
+    p = fd.message_type.add(name="WidgetPart")
+    p.field.add(name="pos", number=1, label=1, type=13)
+    (gen / "widget_pb2.py").write_text(
+        "DESCRIPTOR = _descriptor_pool.Default()."
+        "AddSerializedFile(%r)\n" % fd.SerializeToString())
+    return pkg
+
+
+def _fixture_golden(tmp_path, **schema):
+    import json as _json
+
+    golden = tmp_path / "golden.json"
+    golden.write_text(_json.dumps(schema))
+    return str(golden)
+
+
+_WIDGET_GOLDEN = {
+    "widget.proto": {
+        "messages": {
+            "WidgetMsg": {"a": [1, "string", ""],
+                          "parts": [2, "WidgetPart", "repeated"]},
+            "WidgetPart": {"pos": [1, "uint32", ""]},
+        },
+        "enums": {},
+    },
+}
+
+
+def test_wire_clean(tmp_path):
+    pkg = _write_fixture_api(tmp_path)
+    golden = _fixture_golden(tmp_path, **_WIDGET_GOLDEN)
+    findings, _ = analyze_paths([str(pkg)],
+                                AnalyzerConfig(wire_golden=golden))
+    assert not live(findings), [f.render() for f in live(findings)]
+
+
+def test_wire_drift_proto_vs_gen(tmp_path):
+    # Proto text says field number 3, the committed gen module says 1.
+    pkg = _write_fixture_api(tmp_path, proto_number=3)
+    findings, _ = analyze_paths([str(pkg)], AnalyzerConfig())
+    drift = live(findings, "wire-drift")
+    assert len(drift) == 1 and "field number 3" in drift[0].message
+    assert drift[0].line == 4  # the field's line in widget.proto
+
+
+def test_wire_golden_renumbered_field_fails(tmp_path):
+    """Acceptance gate: a deliberately renumbered proto field must
+    fail against the committed golden descriptor."""
+    pkg = _write_fixture_api(tmp_path, gen_number=7, proto_number=7)
+    golden = _fixture_golden(tmp_path, **_WIDGET_GOLDEN)
+    findings, _ = analyze_paths([str(pkg)],
+                                AnalyzerConfig(wire_golden=golden))
+    wg = live(findings, "wire-golden")
+    assert wg and any("[1, 'string', ''] -> [7, 'string', '']"
+                      in f.message for f in wg)
+
+
+def test_wire_golden_removed_field_fails(tmp_path):
+    pkg = _write_fixture_api(tmp_path, gen_field="b")
+    # gen/proto agree (field renamed b) but golden pins `a`.
+    golden = _fixture_golden(tmp_path, **{
+        "widget.proto": {
+            "messages": {
+                "WidgetMsg": {"a": [1, "string", ""],
+                              "parts": [2, "WidgetPart", "repeated"]},
+                "WidgetPart": {"pos": [1, "uint32", ""]},
+            },
+            "enums": {},
+        },
+    })
+    findings, _ = analyze_paths([str(pkg)],
+                                AnalyzerConfig(wire_golden=golden))
+    wg = live(findings, "wire-golden")
+    assert any("REMOVED" in f.message and "WidgetMsg.a" in f.message
+               for f in wg)
+    assert any("new field WidgetMsg.b" in f.message for f in wg)
+
+
+def test_wire_unknown_field_in_code(tmp_path):
+    pkg = _write_fixture_api(tmp_path)
+    mod = pkg / "handlers.py"
+    mod.write_text(textwrap.dedent("""\
+        def build(api):
+            good = api.WidgetMsg(a="x")
+            bad = api.WidgetMsg(bogus="y")
+            return good, bad
+
+
+        def build_repeated(msg):
+            msg.parts.add(pos=1)
+            msg.parts.add(offset=2)
+        """))
+    findings, _ = analyze_paths([str(pkg)], AnalyzerConfig())
+    wf = live(findings, "wire-unknown-field")
+    msgs = "\n".join(f.message for f in wf)
+    assert "bogus" in msgs and "offset" in msgs and "pos" not in msgs
+    assert len(wf) == 2
+
+
+def test_package_golden_matches_committed_gen():
+    """The pinned golden must match the committed gen modules exactly
+    (the self-check asserts no findings; this asserts the pin is not
+    stale the other way — regenerating it is a no-op)."""
+    from yadcc_tpu.analysis import wirecompat
+
+    golden_path = os.path.join(PKG_DIR, "analysis", "wire_golden.json")
+    with open(golden_path) as fp:
+        committed = json.load(fp)
+    rebuilt = wirecompat.build_golden(
+        wirecompat.find_api_dirs([PKG_DIR]))
+    assert json.loads(json.dumps(rebuilt)) == committed
+
+
+# ---------------------------------------------------------------------------
+# baseline / stats / result cache (v2 infra)
+# ---------------------------------------------------------------------------
+
+
+def _bad_tree(tmp_path):
+    bad = tmp_path / "scheduler"
+    bad.mkdir(exist_ok=True)
+    (bad / "m.py").write_text(textwrap.dedent("""
+        import threading, time
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+        """))
+    return tmp_path
+
+
+def _run_cli(*args, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "yadcc_tpu.analysis", *args],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, **kw)
+
+
+def test_baseline_roundtrip(tmp_path):
+    tree = _bad_tree(tmp_path)
+    bl = tmp_path / "baseline.txt"
+    proc = _run_cli(str(tree), "--no-cache", "--write-baseline", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert bl.read_text().strip()
+    # With the baseline, the same tree is green...
+    proc = _run_cli(str(tree), "--no-cache", "--baseline", str(bl))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baselined" in proc.stdout
+    # ...and a NEW finding still fails.
+    (tree / "scheduler" / "m2.py").write_text(textwrap.dedent("""
+        import threading, time
+
+        class U:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def g(self):
+                with self._lock:
+                    time.sleep(2)
+        """))
+    proc = _run_cli(str(tree), "--no-cache", "--baseline", str(bl))
+    assert proc.returncode == 1
+
+
+def test_stats_flag(tmp_path):
+    tree = _bad_tree(tmp_path)
+    proc = _run_cli(str(tree), "--no-cache", "--stats")
+    assert "lockrules" in proc.stdout and "cache:" in proc.stdout
+
+
+def test_result_cache_hits_and_invalidation(tmp_path):
+    from yadcc_tpu.analysis.cache import ResultCache
+
+    tree = _bad_tree(tmp_path)
+    cpath = tmp_path / "cache.json"
+    cfg = AnalyzerConfig()
+
+    cache = ResultCache(str(cpath))
+    cold, stats_cold = analyze_paths([str(tree)], cfg, cache=cache)
+    cache.save()
+    assert stats_cold["cache_hits"] == 0 and cpath.exists()
+
+    cache = ResultCache(str(cpath))
+    warm, stats_warm = analyze_paths([str(tree)], cfg, cache=cache)
+    assert stats_warm["cache_hits"] == stats_warm["files_analyzed"]
+    assert [f.as_dict() for f in warm] == [f.as_dict() for f in cold]
+
+    # Editing a file invalidates just that file's entry; adding a
+    # directive anywhere invalidates everything (global key).
+    (tree / "scheduler" / "m.py").write_text(
+        (tree / "scheduler" / "m.py").read_text() + "\n# touched\n")
+    cache = ResultCache(str(cpath))
+    _, stats3 = analyze_paths([str(tree)], cfg, cache=cache)
+    assert stats3["cache_hits"] == stats3["files_analyzed"] - 1
+
+    # Corruption degrades to a cold run, never an error.
+    cpath.write_text("{not json")
+    cache = ResultCache(str(cpath))
+    again, stats4 = analyze_paths([str(tree)], cfg, cache=cache)
+    assert stats4["cache_hits"] == 0
+    assert [f.as_dict() for f in again] == [f.as_dict() for f in cold]
+
+
+# ---------------------------------------------------------------------------
+# v2 has-teeth: the trust boundary is actually annotated.
+# ---------------------------------------------------------------------------
+
+
+def _count_directive(regex):
+    import yadcc_tpu.analysis.core as core
+
+    n = 0
+    per_file = {}
+    for dirpath, _, files in os.walk(PKG_DIR):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname)) as fp:
+                hits = sum(1 for line in fp if regex.search(line))
+            if hits:
+                per_file[os.path.join(dirpath, fname)] = hits
+                n += hits
+    return n, per_file
+
+
+def test_sanitizer_annotations_have_teeth():
+    """>=10 sanitizes(...) annotations must sit on real validation
+    helpers — the taint pass is only meaningful if the boundary is
+    declared."""
+    import yadcc_tpu.analysis.core as core
+
+    n, per_file = _count_directive(core._SANITIZES_RE)
+    assert n >= 10, f"only {n} sanitizes annotations: {per_file}"
+
+
+def test_untrusted_sources_declared_at_the_boundary():
+    """Every network intake module declares its sources; an intake
+    surface silently losing its declaration would turn the taint pass
+    into a no-op there."""
+    import yadcc_tpu.analysis.core as core
+
+    n, per_file = _count_directive(core._UNTRUSTED_RE)
+    assert n >= 8, f"only {n} untrusted annotations: {per_file}"
+    must_declare = ["daemon_service.py", "http_service.py",
+                    "transport.py"]
+    for stem in must_declare:
+        assert any(path.endswith(stem) for path in per_file), \
+            f"{stem} declares no untrusted sources"
+
+
+def test_acquire_annotations_cover_the_workspace_factories():
+    import yadcc_tpu.analysis.core as core
+
+    n, per_file = _count_directive(core._ACQUIRES_RE)
+    assert n >= 2, f"only {n} acquires annotations: {per_file}"
+
+
+# ---------------------------------------------------------------------------
+# Regression tests for the defects the v2 rules surfaced.
+# ---------------------------------------------------------------------------
+
+
+def test_queue_handler_cleans_workspace_on_engine_failure(tmp_path):
+    """lifecycle-exc-path regression: an engine failure between
+    prepare() and a successful queue used to leak the RAM-backed
+    workspace (nothing else ever reclaims /dev/shm space)."""
+    from yadcc_tpu import api
+    from yadcc_tpu.common import compress
+    from yadcc_tpu.daemon.config import DaemonConfig
+    from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
+    from yadcc_tpu.rpc import RpcContext, RpcError
+
+    class BoomEngine:
+        def find_task_by_digest(self, digest):
+            return None
+
+        def reference_task(self, tid):
+            return False
+
+        def try_queue_task(self, **kw):
+            raise RuntimeError("engine exploded")
+
+    class Registry:
+        def try_get_compiler_path(self, digest):
+            return "/usr/bin/true"
+
+        def environments(self):
+            return []
+
+    svc = DaemonService(DaemonConfig(temporary_dir=str(tmp_path),
+                                     location="127.0.0.1:0"),
+                        engine=BoomEngine(), registry=Registry(),
+                        jit_environments=[])
+    svc.set_acceptable_tokens_for_testing(["tok"])
+    req = api.daemon.QueueCxxCompilationTaskRequest(
+        token="tok", task_grant_id=1, source_path="/x.cc",
+        invocation_arguments="-O2",
+        compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+    req.env_desc.compiler_digest = "d" * 8
+    with pytest.raises(RuntimeError):
+        svc.QueueCxxCompilationTask(
+            req, compress.compress(b"int main(){}"), RpcContext())
+    leftovers = [p for p in os.listdir(tmp_path)
+                 if p.startswith("ytpu_")]
+    assert leftovers == [], f"workspace leaked: {leftovers}"
+
+    # Saturation (None from the engine) also cleans up, and still maps
+    # to the HEAVILY_LOADED status.
+    class FullEngine(BoomEngine):
+        def try_queue_task(self, **kw):
+            return None
+
+    svc.engine = FullEngine()
+    with pytest.raises(RpcError) as ei:
+        svc.QueueCxxCompilationTask(
+            req, compress.compress(b"int main(){}"), RpcContext())
+    assert ei.value.status == api.daemon.DAEMON_STATUS_HEAVILY_LOADED
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("ytpu_")]
+
+
+def test_collect_outputs_removes_workspace_on_pack_failure(
+        tmp_path, monkeypatch):
+    """lifecycle regression: a compressor/pool failure during output
+    packing used to return before the workspace remove."""
+    from yadcc_tpu.common import compress
+    from yadcc_tpu.daemon.cloud.jit_task import CloudJitCompilationTask
+    from yadcc_tpu.daemon.cloud.execution_engine import TaskOutput
+
+    task = CloudJitCompilationTask(
+        env_digest="e" * 8, backend="cpu", compile_options=b"",
+        claimed_computation_digest="", temp_root=str(tmp_path))
+    task.prepare(compress.compress(b"module {}"))
+    ws = task.workspace.path
+    with open(os.path.join(ws, "artifact.bin"), "wb") as fp:
+        fp.write(b"FAKE")
+
+    def boom(data):
+        raise RuntimeError("compressor died")
+
+    monkeypatch.setattr(
+        "yadcc_tpu.daemon.cloud.jit_task.compress.compress", boom)
+    with pytest.raises(RuntimeError):
+        task.collect_outputs(TaskOutput(exit_code=0,
+                                        standard_output=b"",
+                                        standard_error=b""))
+    assert not os.path.exists(ws), "workspace leaked on pack failure"
+
+
+def test_guess_local_ip_closes_socket_on_failure(monkeypatch):
+    """lifecycle-exc-path regression: a connect() failure used to
+    return through the except without closing the fd — one leaked fd
+    per retry while DNS flapped."""
+    from yadcc_tpu.daemon import entry
+
+    closed = []
+
+    class FakeSock:
+        def connect(self, addr):
+            raise OSError("unreachable")
+
+        def getsockname(self):
+            return ("1.2.3.4", 0)
+
+        def close(self):
+            closed.append(True)
+
+    monkeypatch.setattr(entry.socket, "socket",
+                        lambda *a, **kw: FakeSock())
+    assert entry._guess_local_ip("grpc://10.0.0.1:8336") == "127.0.0.1"
+    assert closed, "socket fd leaked on the failure path"
+
+
+def test_execute_command_reaps_child_on_sink_failure():
+    """lifecycle regression: a sink.write failure mid-stream used to
+    propagate without killing/reaping the child process."""
+    import subprocess as sp
+
+    from yadcc_tpu.client import command as cmd
+
+    procs = []
+    real_popen = sp.Popen
+
+    def recording_popen(*a, **kw):
+        p = real_popen(*a, **kw)
+        procs.append(p)
+        return p
+
+    class BoomSink:
+        def write(self, chunk):
+            raise RuntimeError("disk full")
+
+    orig = cmd.subprocess.Popen
+    cmd.subprocess.Popen = recording_popen
+    try:
+        with pytest.raises(RuntimeError):
+            cmd.execute_command(["yes"], sink=BoomSink())
+    finally:
+        cmd.subprocess.Popen = orig
+    assert procs and procs[0].poll() is not None, \
+        "child left running after sink failure"

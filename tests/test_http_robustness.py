@@ -100,3 +100,84 @@ class TestMalformedInputs:
     def test_get_version_with_post(self, svc):
         status, _ = post(svc, "/local/get_version", b"")
         assert status == 404  # GET-only route
+
+
+class TestTrustBoundaryCaps:
+    """Regression tests for the v2 taint-pass findings: every quantity
+    a local client controls is capped before it costs anything."""
+
+    def test_oversized_content_length_is_413(self, svc):
+        """taint-alloc regression: do_POST buffered rfile.read(length)
+        straight from the Content-Length header — a hostile local
+        process claiming terabytes reached the allocator.  The header
+        is now capped (413) before any buffering."""
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=15.0)
+        try:
+            conn.putrequest("POST", "/local/acquire_quota")
+            conn.putheader("Content-Type", "application/json")
+            # Claim 8TB; send nothing.  The reply must come back from
+            # the header check alone.
+            conn.putheader("Content-Length", str(8 << 40))
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 413
+        finally:
+            conn.close()
+
+    def test_unparseable_content_length_is_413(self, svc):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                          timeout=15.0)
+        try:
+            conn.putrequest("POST", "/local/acquire_quota")
+            conn.putheader("Content-Length", "zillions")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 413
+        finally:
+            conn.close()
+
+    def test_acquire_quota_wait_is_clamped(self):
+        """taint-wait regression: /local/acquire_quota passed the
+        client's milliseconds_to_wait straight into the quota waiter —
+        one request could park a serving thread for 49 days (uint32
+        max).  The wait is now clamped to MAX_WAIT_S."""
+        from yadcc_tpu.common.limits import MAX_WAIT_S
+        from yadcc_tpu.daemon.local.http_service import LocalHttpService
+
+        seen = []
+
+        class RecordingMonitor:
+            def wait_for_running_new_task_permission(
+                    self, pid, lightweight, timeout_s):
+                seen.append(timeout_s)
+                return True
+
+            def drop_task_permission(self, pid):
+                pass
+
+        service = LocalHttpService(
+            monitor=RecordingMonitor(),
+            digest_cache=FileDigestCache(),
+            dispatcher=DistributedTaskDispatcher(
+                grant_keeper=_NullGrants(), config_keeper=_NullConfig(),
+                pid_prober=lambda p: True),
+            port=0,
+        )
+        service.start()
+        try:
+            body = json.dumps({
+                "requestor_pid": 1,
+                "lightweight_task": False,
+                # uint32 max: ~49.7 days of parked thread pre-fix.
+                "milliseconds_to_wait": 4_294_967_295,
+            }).encode()
+            status, _ = post(service, "/local/acquire_quota", body)
+            assert status == 200
+            assert seen and seen[0] <= MAX_WAIT_S
+        finally:
+            service.stop()

@@ -14,11 +14,31 @@ cd "$(dirname "$0")/.." || exit 1
 
 fail=0
 
-echo "== lint (ytpu-analyze + shellcheck) =="
-# The static concurrency/jit analyzer must come back clean — zero
-# unsuppressed findings over the package (doc/static_analysis.md).
-if ! python -m yadcc_tpu.analysis yadcc_tpu; then
+echo "== lint (ytpu-analyze + wire-compat + shellcheck) =="
+# The static concurrency/jit/taint/lifecycle/wire-compat analyzer must
+# come back clean — zero unsuppressed findings over the package
+# (doc/static_analysis.md).  The findings report ships as a CI
+# artifact, and the stage is wall-time-bounded so the content-hash
+# result cache regressing to cold-parse speed is itself a failure.
+mkdir -p artifacts
+lint_t0=$SECONDS
+if ! python -m yadcc_tpu.analysis yadcc_tpu --stats \
+       --json artifacts/ytpu_analyze.json; then
   echo "ytpu-analyze FAILED" >&2
+  fail=1
+fi
+lint_secs=$((SECONDS - lint_t0))
+echo "lint wall time: ${lint_secs}s"
+if [ "$lint_secs" -gt 120 ]; then
+  echo "lint stage exceeded its 120s budget (${lint_secs}s)" >&2
+  fail=1
+fi
+# Wire-format golden gates: the committed gen modules for the
+# pure-maintained protos must be byte-identical to what --pure emits
+# (descriptor drift fails before it ships), and the analyzer above
+# already cross-checked protos <-> gen <-> analysis/wire_golden.json.
+if ! python -m yadcc_tpu.api.build_protos --check; then
+  echo "proto pure-build byte-idempotence FAILED" >&2
   fail=1
 fi
 # Shell hygiene for the ops scripts.  Boxes without shellcheck (this

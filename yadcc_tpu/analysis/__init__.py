@@ -29,10 +29,26 @@ Rule families (doc/static_analysis.md has the full catalog):
   hot paths (the sub-2ms grant budget leaves no room for any of them).
 * ``jit-nondet`` / ``jit-tracer-if`` / ``jit-static-unhashable`` —
   jit hygiene inside ``@jax.jit`` functions in ops/ and parallel/.
+* ``taint-*`` — interprocedural untrusted-taint: sources declared
+  ``# ytpu: untrusted(...)`` on the network intake functions,
+  sanitizers declared ``# ytpu: sanitizes(size-cap|key-domain|...)``
+  on the validation helpers, sinks = allocations/waits/paths/argv/
+  cache keys; ``taint-registry`` proves every registered TaskType
+  routes its intake through validation (taint.py).
+* ``lifecycle-*`` — acquire/release pairing across exception paths
+  for temp workspaces, handles, pools and subprocesses, plus
+  ``# ytpu: acquires(...)`` receiver tracking and mutable-buffer view
+  escapes (lifecycle.py).
+* ``wire-*`` — api/protos ↔ committed gen descriptors ↔ the pinned
+  golden (analysis/wire_golden.json) ↔ field accesses in handler code
+  (wirecompat.py); renumbering a field fails lint before it breaks
+  the byte-identical wire/cache invariant.
 
 Findings carry rule id + file:line and honor
 ``# ytpu: allow(<rule>)  # reason`` suppressions (a suppression
-without a written reason is itself a finding).
+without a written reason is itself a finding).  ``--baseline``,
+``--stats`` and a content-hash result cache keep the gate incremental
+and fast (doc/static_analysis.md).
 """
 
 from __future__ import annotations

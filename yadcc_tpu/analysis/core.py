@@ -42,9 +42,42 @@ RULES: Dict[str, str] = {
                      "@jax.jit function",
     "jit-static-unhashable": "unhashable value bound to a static jit "
                              "argument",
+    "taint-alloc": "allocation / read sized by an untrusted integer "
+                   "without a size-cap sanitizer",
+    "taint-wait": "untrusted value controls a timeout/wait duration "
+                  "without a size-cap sanitizer",
+    "taint-path": "untrusted value reaches filesystem path construction "
+                  "without a path sanitizer",
+    "taint-argv": "untrusted value reaches subprocess argv without an "
+                  "argv sanitizer (shlex.quote)",
+    "taint-cache-key": "untrusted value used as a cache key without a "
+                       "key-domain sanitizer",
+    "taint-registry": "a registered TaskType whose factory cannot be "
+                      "proven to route its intake through validation",
+    "lifecycle-leak": "acquired resource neither released, escaped, nor "
+                      "with-managed on some path",
+    "lifecycle-exc-path": "resource released only on the happy path "
+                          "(no with / try-finally / except cleanup)",
+    "lifecycle-view-escape": "memoryview over a local mutable buffer "
+                             "escapes the function",
+    "wire-drift": "api/protos/*.proto disagrees with the committed "
+                  "api/gen/*_pb2.py descriptor",
+    "wire-golden": "wire format diverged from the committed golden "
+                   "descriptor (analysis/wire_golden.json)",
+    "wire-unknown-field": "message constructed with a field name the "
+                          "descriptor does not define",
     "suppression": "malformed suppression or suppression without a "
                    "written reason",
     "parse-error": "file could not be parsed",
+}
+
+# Sink kind -> sanitizer tags that clear it (taint family).
+SINK_REQUIRED_TAGS: Dict[str, frozenset] = {
+    "alloc": frozenset({"size-cap"}),
+    "wait": frozenset({"size-cap"}),
+    "path": frozenset({"path"}),
+    "argv": frozenset({"argv"}),
+    "cache-key": frozenset({"key-domain"}),
 }
 
 # Factories whose call result is a lock / a condition.  Matched on the
@@ -61,6 +94,17 @@ CONSTRUCTION_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
 _SUPPRESS_RE = re.compile(
     r"#\s*ytpu:\s*allow\(\s*([A-Za-z0-9_*,\- ]*)\s*\)\s*(.*)$")
 _GUARD_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z0-9_.\[\]'\"]+)\s*$")
+# Trust-boundary directives (taint + lifecycle families).  All three
+# ride the `def` line (or a line of its signature / the line directly
+# above its first decorator) as trailing comments:
+#
+#   def decompress(data, cap):   # ytpu: sanitizes(size-cap)
+#   def prepare(self, src):      # ytpu: acquires(workspace)
+#   def QueueTask(self, req, attachment, ctx):  # ytpu: untrusted(req, attachment)
+_SANITIZES_RE = re.compile(r"#\s*ytpu:\s*sanitizes\(\s*([A-Za-z0-9_,\- ]*)\s*\)")
+_ACQUIRES_RE = re.compile(r"#\s*ytpu:\s*acquires\(\s*([A-Za-z0-9_,\- ]*)\s*\)")
+_UNTRUSTED_RE = re.compile(
+    r"#\s*ytpu:\s*untrusted\(\s*([A-Za-z0-9_.,\s]*)\s*\)")
 
 
 @dataclass
@@ -80,6 +124,15 @@ class Finding:
     def as_dict(self) -> dict:
         return {"rule": self.rule, "path": self.path, "line": self.line,
                 "message": self.message, "suppressed": self.suppressed}
+
+
+def baseline_key(f: Finding) -> str:
+    """Line-number-free identity for --baseline files: unrelated edits
+    shifting a file must not invalidate the whole baseline."""
+    import hashlib
+
+    digest = hashlib.sha256(f.message.encode()).hexdigest()[:12]
+    return f"{f.rule}|{f.path}|{digest}"
 
 
 @dataclass
@@ -105,6 +158,16 @@ class AnalyzerConfig:
     # rule evolution must not turn a stale-but-documented allow into a
     # gate failure).
     strict_suppressions: bool = False
+    # Committed golden wire descriptor (analysis/wire_golden.json).
+    # None = skip the golden comparison (proto<->gen drift and unknown-
+    # field checks still run whenever an api/protos tree is analyzed).
+    wire_golden: Optional[str] = None
+
+    def digest_fields(self) -> dict:
+        """The fields a cached result depends on."""
+        return {"hot": list(self.hot_path_fragments),
+                "jit": list(self.jit_path_fragments),
+                "ranks": dict(self.lock_ranks)}
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +186,9 @@ class Directives:
     def __init__(self, source: str):
         self.suppressions: Dict[int, Suppression] = {}
         self.guards: Dict[int, str] = {}   # lineno -> lock expr
+        self.sanitizes: Dict[int, Set[str]] = {}   # lineno -> tags
+        self.acquires: Dict[int, Set[str]] = {}    # lineno -> tags
+        self.untrusted: Dict[int, List[str]] = {}  # lineno -> param specs
         for lineno, text in enumerate(source.splitlines(), start=1):
             if "#" not in text:
                 continue
@@ -136,6 +202,21 @@ class Directives:
             g = _GUARD_RE.search(text)
             if g:
                 self.guards[lineno] = g.group(1)
+            s = _SANITIZES_RE.search(text)
+            if s:
+                self.sanitizes[lineno] = {t.strip()
+                                          for t in s.group(1).split(",")
+                                          if t.strip()}
+            a = _ACQUIRES_RE.search(text)
+            if a:
+                self.acquires[lineno] = {t.strip()
+                                         for t in a.group(1).split(",")
+                                         if t.strip()}
+            u = _UNTRUSTED_RE.search(text)
+            if u:
+                self.untrusted[lineno] = [t.strip()
+                                          for t in u.group(1).split(",")
+                                          if t.strip()]
 
     def suppression_for(self, line: int, rule: str) -> Optional[Suppression]:
         s = self.suppressions.get(line)
@@ -304,6 +385,104 @@ def build_module_model(path: str, relpath: str, source: str,
                 info.cond_aliases[target.attr] = None
         model.classes[node.name] = info
     return model
+
+
+# ---------------------------------------------------------------------------
+# Whole-tree function collection (taint / lifecycle / registry passes).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FunctionInfo:
+    """One def anywhere in a module (methods, nested classes included),
+    with the trust-boundary directives attached to its signature."""
+
+    qualname: str            # "modname.Class.func" / "modname.func"
+    name: str                # last segment
+    relpath: str
+    lineno: int
+    params: List[str]
+    cls: Optional[str] = None
+    sanitizes: Set[str] = field(default_factory=set)
+    acquires: Set[str] = field(default_factory=set)
+    untrusted: List[str] = field(default_factory=list)
+    # Filled by the taint summary pass (taint.summarize_function);
+    # JSON-serializable so the result cache can persist it.
+    taint: Optional[dict] = None
+    node: Optional[ast.AST] = None   # not serialized
+
+    def to_dict(self) -> dict:
+        return {"qualname": self.qualname, "name": self.name,
+                "relpath": self.relpath, "lineno": self.lineno,
+                "params": list(self.params), "cls": self.cls,
+                "sanitizes": sorted(self.sanitizes),
+                "acquires": sorted(self.acquires),
+                "untrusted": list(self.untrusted),
+                "taint": self.taint}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FunctionInfo":
+        return cls(qualname=d["qualname"], name=d["name"],
+                   relpath=d["relpath"], lineno=d["lineno"],
+                   params=list(d["params"]), cls=d.get("cls"),
+                   sanitizes=set(d.get("sanitizes", ())),
+                   acquires=set(d.get("acquires", ())),
+                   untrusted=list(d.get("untrusted", ())),
+                   taint=d.get("taint"))
+
+
+def _signature_lines(node: ast.AST) -> Set[int]:
+    """Line numbers where a def's directives may sit: the decorator /
+    signature span, plus the line directly above it (long signatures put
+    the directive on its own comment line)."""
+    start = node.lineno
+    for deco in getattr(node, "decorator_list", ()):
+        start = min(start, deco.lineno)
+    body_start = node.body[0].lineno if node.body else node.lineno + 1
+    lines = set(range(start, max(body_start, node.lineno + 1)))
+    lines.add(start - 1)
+    lines.add(node.lineno)
+    return lines
+
+
+def collect_functions(model: ModuleModel) -> List[FunctionInfo]:
+    """Every def in the module, depth-first, with qualified names and
+    signature directives resolved.  Unlike iter_functions (which feeds
+    the held-lock walk and must not descend), this sees nested defs and
+    classes defined inside functions (e.g. HTTP handler classes built
+    in a service __init__)."""
+    out: List[FunctionInfo] = []
+    d = model.directives
+
+    def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}"
+                a = child.args
+                params = [p.arg for p in
+                          (a.posonlyargs + a.args + a.kwonlyargs)]
+                info = FunctionInfo(
+                    qualname=qual, name=child.name, relpath=model.relpath,
+                    lineno=child.lineno, params=params, cls=cls,
+                    node=child)
+                for ln in _signature_lines(child):
+                    if ln in d.sanitizes:
+                        info.sanitizes |= d.sanitizes[ln]
+                    if ln in d.acquires:
+                        info.acquires |= d.acquires[ln]
+                    if ln in d.untrusted:
+                        info.untrusted.extend(
+                            s for s in d.untrusted[ln]
+                            if s not in info.untrusted)
+                out.append(info)
+                visit(child, qual, cls)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}.{child.name}", child.name)
+            else:
+                visit(child, prefix, cls)
+
+    visit(model.tree, model.modname, None)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -491,8 +670,136 @@ def _collect_py_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
     return out
 
 
+@dataclass
+class _FileRecord:
+    relpath: str
+    path: str
+    source: str
+    content_hash: str
+    model: Optional[ModuleModel] = None        # parsed lazily / on miss
+    functions: List[FunctionInfo] = field(default_factory=list)
+    callsites: List[dict] = field(default_factory=list)
+    local_findings: Optional[List[Finding]] = None
+    from_cache: bool = False
+
+
+def _collect_callsites(model: ModuleModel) -> List[dict]:
+    """Flat record of every call with keyword arguments plus the
+    TaskType registrations — enough for the wire-compat unknown-field
+    check and the taint-registry check to run without the AST (so a
+    cache hit skips parsing entirely)."""
+    sites: List[dict] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = last_segment(node.func)
+        if last is None:
+            continue
+        kwargs = [kw.arg for kw in node.keywords if kw.arg]
+        chain: List[str] = []
+        f = node.func
+        while isinstance(f, ast.Attribute):
+            chain.append(f.attr)
+            f = f.value
+        if isinstance(f, ast.Name):
+            chain.append(f.id)
+        chain.reverse()
+        if last == "TaskType" and kwargs:
+            kind = None
+            factories: List[str] = []
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    kind = kw.value.value
+                if kw.arg == "make_task":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Call):
+                            seg = last_segment(sub.func)
+                            if seg:
+                                factories.append(seg)
+                        elif isinstance(sub, ast.Name):
+                            factories.append(sub.id)
+            lam_params: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "make_task" and \
+                        isinstance(kw.value, ast.Lambda):
+                    lam_params = {a.arg for a in kw.value.args.args}
+            factories = [n for n in factories
+                         if n not in lam_params and n != "TaskType"]
+            sites.append({"tasktype": True, "kind": kind,
+                          "factories": factories, "line": node.lineno})
+        if kwargs:
+            sites.append({"last": last, "chain": chain,
+                          "kwargs": kwargs, "line": node.lineno})
+    return sites
+
+
+_DEF_NAME_RE = re.compile(r"^\s*(?:async\s+)?def\s+(\w+)")
+
+
+def scan_directives(sources: Dict[str, str]
+                    ) -> Tuple[str, Dict[str, Set[str]], Set[str]]:
+    """Regex pre-pass over raw sources (no parsing): returns
+    (fingerprint, sanitizer map, acquires name set).
+
+    Per-file analysis results depend on which *names* carry sanitizes/
+    acquires/untrusted annotations anywhere in the tree (the taint pass
+    resolves sanitizer calls by name across modules), so the result
+    cache keys on this fingerprint alongside each file's content hash —
+    retargeting an annotation invalidates everything, cheaply detected
+    before any AST work."""
+    import hashlib
+
+    entries: List[Tuple[str, int, str, str]] = []
+    sanitizers: Dict[str, Set[str]] = {}
+    acquires: Set[str] = set()
+    for rel in sorted(sources):
+        lines = sources[rel].splitlines()
+        for i, text in enumerate(lines):
+            if "ytpu:" not in text:
+                continue
+            hit = None
+            for regex, kind in ((_SANITIZES_RE, "sanitizes"),
+                                (_ACQUIRES_RE, "acquires"),
+                                (_UNTRUSTED_RE, "untrusted")):
+                m = regex.search(text)
+                if m:
+                    hit = (kind, m.group(1))
+                    break
+            if hit is None:
+                continue
+            # Associate with the owning def: same line; a pure-comment
+            # line binds to the def below (above-decorator style); a
+            # trailing comment on a signature continuation line binds
+            # to the def above.
+            defname = ""
+            dm = _DEF_NAME_RE.match(text)
+            if dm:
+                defname = dm.group(1)
+            elif text.lstrip().startswith("#"):
+                for j in range(i + 1, min(i + 9, len(lines))):
+                    dm = _DEF_NAME_RE.match(lines[j])
+                    if dm:
+                        defname = dm.group(1)
+                        break
+            else:
+                for j in range(i - 1, max(i - 9, -1), -1):
+                    dm = _DEF_NAME_RE.match(lines[j])
+                    if dm:
+                        defname = dm.group(1)
+                        break
+            entries.append((rel, i + 1, defname, f"{hit[0]}({hit[1]})"))
+            tags = {t.strip() for t in hit[1].split(",") if t.strip()}
+            if defname and hit[0] == "sanitizes":
+                sanitizers.setdefault(defname, set()).update(tags)
+            elif defname and hit[0] == "acquires":
+                acquires.add(defname)
+    fp = hashlib.sha256(repr(entries).encode()).hexdigest()
+    return fp, sanitizers, acquires
+
+
 def analyze_paths(paths: Sequence[str],
-                  config: Optional[AnalyzerConfig] = None
+                  config: Optional[AnalyzerConfig] = None,
+                  cache=None,
                   ) -> Tuple[List[Finding], dict]:
     """Run every rule family over the given files/directories.
 
@@ -501,53 +808,164 @@ def analyze_paths(paths: Sequence[str],
     with ``suppressed=True``; a suppression without a reason adds a
     ``suppression`` finding of its own.  The process exit decision
     belongs to the caller (__main__): unsuppressed findings fail.
+
+    ``cache`` is an optional analysis.cache.ResultCache: per-file parse
+    + rule results are reused when the file's content hash, the global
+    directive digest and the analyzer fingerprint all match.
     """
-    from . import jit_hygiene, lockrules
+    import hashlib
+    import time as _time
+
+    from . import jit_hygiene, lifecycle, lockrules, taint, wirecompat
 
     config = config or AnalyzerConfig()
     files = _collect_py_files(paths)
     findings: List[Finding] = []
-    analyzed = 0
+    timings: Dict[str, float] = {}
+    records: List[_FileRecord] = []
+    cache_hits = 0
+
+    def _timed(name: str, fn, *args):
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        timings[name] = timings.get(name, 0.0) + _time.perf_counter() - t0
+        return out
+
+    # -- phase 0: read sources, directive pre-pass -------------------------
+    t0 = _time.perf_counter()
+    sources: Dict[str, str] = {}
+    by_rel: Dict[str, Tuple[str, str]] = {}
     for rel, path in files:
         try:
             with open(path, "r", encoding="utf-8") as fp:
-                source = fp.read()
-            tree = ast.parse(source, filename=path)
-        except (OSError, SyntaxError) as e:
+                sources[rel] = fp.read()
+            by_rel[rel] = (rel, path)
+        except OSError as e:
             findings.append(Finding("parse-error", rel, 1, str(e)))
+    directive_fp, sanitizer_map, acquires_names = scan_directives(sources)
+    cfg_fp = hashlib.sha256(
+        repr(sorted(config.digest_fields().items())).encode()).hexdigest()
+    global_key = hashlib.sha256(
+        (directive_fp + cfg_fp).encode()).hexdigest()
+
+    # -- phase 1: per-file analysis (cache-keyed on content + globals) -----
+    for rel, path in files:
+        if rel not in sources:
             continue
-        analyzed += 1
-        model = build_module_model(path, rel, source, tree)
-        raw: List[Finding] = []
-        raw.extend(lockrules.check_module(model, config))
-        raw.extend(jit_hygiene.check_module(model, config))
-        # Suppression pass.
-        for f in raw:
-            s = model.directives.suppression_for(f.line, f.rule)
+        source = sources[rel]
+        rec = _FileRecord(
+            relpath=rel, path=path, source=source,
+            content_hash=hashlib.sha256(source.encode()).hexdigest())
+        entry = (cache.get(rec.content_hash, global_key)
+                 if cache is not None else None)
+        if entry is not None:
+            rec.functions = [FunctionInfo.from_dict(d)
+                             for d in entry.get("functions", ())]
+            rec.callsites = list(entry.get("callsites", ()))
+            rec.local_findings = [Finding(**d)
+                                  for d in entry.get("findings", ())]
+            rec.from_cache = True
+            cache_hits += 1
+        else:
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                findings.append(Finding("parse-error", rel, 1, str(e)))
+                continue
+            rec.model = build_module_model(path, rel, source, tree)
+            rec.functions = collect_functions(rec.model)
+            _timed("taint", taint.summarize_functions,
+                   rec.model, rec.functions, sanitizer_map)
+            rec.callsites = _collect_callsites(rec.model)
+            raw: List[Finding] = []
+            raw.extend(_timed("lockrules", lockrules.check_module,
+                              rec.model, config))
+            raw.extend(_timed("jit-hygiene", jit_hygiene.check_module,
+                              rec.model, config))
+            raw.extend(_timed("lifecycle", lifecycle.check_module,
+                              rec.model, config, acquires_names))
+            rec.local_findings = raw
+            if cache is not None:
+                cache.put(rec.content_hash, global_key, {
+                    "functions": [i.to_dict() for i in rec.functions],
+                    "callsites": rec.callsites,
+                    "findings": [{"rule": f.rule, "path": f.path,
+                                  "line": f.line, "message": f.message}
+                                 for f in raw],
+                })
+        records.append(rec)
+    timings["per-file-total"] = _time.perf_counter() - t0
+
+    all_functions: List[FunctionInfo] = []
+    for rec in records:
+        all_functions.extend(rec.functions)
+
+    # -- phase 2: global passes --------------------------------------------
+    tasktype_sites = [dict(s, relpath=rec.relpath)
+                      for rec in records for s in rec.callsites
+                      if s.get("tasktype")]
+    raw_global: List[Finding] = []
+    raw_global.extend(_timed(
+        "taint", taint.check_global, all_functions, tasktype_sites,
+        sanitizer_map))
+    raw_global.extend(_timed(
+        "wire-compat", wirecompat.check_paths, paths, records, config))
+
+    # -- suppression pass --------------------------------------------------
+    directives_by_rel: Dict[str, Directives] = {}
+
+    def _directives(rel: str) -> Optional[Directives]:
+        if rel not in directives_by_rel:
+            rec = next((r for r in records if r.relpath == rel), None)
+            if rec is None:
+                return None
+            if rec.model is not None:
+                directives_by_rel[rel] = rec.model.directives
+            else:
+                directives_by_rel[rel] = Directives(rec.source)
+        return directives_by_rel[rel]
+
+    seen_keys: Set[Tuple[str, str, int, str]] = set()
+    for f in [f for rec in records for f in (rec.local_findings or [])] \
+            + raw_global:
+        key = (f.rule, f.path, f.line, f.message)
+        if key in seen_keys:
+            continue
+        seen_keys.add(key)
+        d = _directives(f.path)
+        if d is not None:
+            s = d.suppression_for(f.line, f.rule)
             if s is not None:
                 s.used = True
                 f.suppressed = True
-            findings.append(f)
-        for s in model.directives.suppressions.values():
+        findings.append(f)
+    for rec in records:
+        d = _directives(rec.relpath)
+        if d is None:
+            continue
+        for s in d.suppressions.values():
             unknown = s.rules - set(RULES) - {"*"}
             if unknown:
                 findings.append(Finding(
-                    "suppression", rel, s.line,
+                    "suppression", rec.relpath, s.line,
                     f"unknown rule id(s) in suppression: "
                     f"{', '.join(sorted(unknown))}"))
             if not s.reason:
                 findings.append(Finding(
-                    "suppression", rel, s.line,
+                    "suppression", rec.relpath, s.line,
                     "suppression without a written reason "
                     "(# ytpu: allow(<rule>)  # why it is safe)"))
             elif config.strict_suppressions and not s.used:
                 findings.append(Finding(
-                    "suppression", rel, s.line,
+                    "suppression", rec.relpath, s.line,
                     "suppression matched no finding"))
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     stats = {
-        "files_analyzed": analyzed,
+        "files_analyzed": len(records),
         "findings": sum(1 for f in findings if not f.suppressed),
         "suppressed": sum(1 for f in findings if f.suppressed),
+        "cache_hits": cache_hits,
+        "timings": {k: round(v, 4) for k, v in sorted(timings.items())},
     }
     return findings, stats

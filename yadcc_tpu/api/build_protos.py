@@ -266,23 +266,49 @@ _builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, {module!r}, globals())
 '''
 
 
+def _render_pure(name: str) -> str:
+    fd = PURE_BUILDERS[name]()
+    stem = name[:-len(".proto")]
+    imports = "\n".join(
+        "from . import {0}_pb2 as {1}__pb2".format(
+            d[:-len(".proto")], d[:-len(".proto")].replace("_", "__"))
+        for d in fd.dependency)
+    return _PURE_TEMPLATE.format(
+        source=name, imports=imports, blob=fd.SerializeToString(),
+        module=f"{stem}_pb2")
+
+
 def build_pure(proto_names=None) -> None:
     """Generate ``gen/*_pb2.py`` for PURE_BUILDERS entries without
     protoc.  Emitted modules match protoc's runtime shape exactly: the
     descriptor pool consumes the same serialized FileDescriptorProto a
     protoc build would embed."""
     for name in proto_names or PURE_BUILDERS:
-        fd = PURE_BUILDERS[name]()
         stem = name[:-len(".proto")]
-        imports = "\n".join(
-            "from . import {0}_pb2 as {1}__pb2".format(
-                d[:-len(".proto")], d[:-len(".proto")].replace("_", "__"))
-            for d in fd.dependency)
         out = GEN_DIR / f"{stem}_pb2.py"
-        out.write_text(_PURE_TEMPLATE.format(
-            source=name, imports=imports, blob=fd.SerializeToString(),
-            module=f"{stem}_pb2"))
+        out.write_text(_render_pure(name))
         print(f"pure-generated {out}")
+
+
+def check_pure(proto_names=None) -> int:
+    """Byte-idempotence gate (tools/ci.sh): the committed gen modules
+    for pure-maintained protos must equal what --pure would emit right
+    now, so descriptor drift fails lint instead of shipping.  Returns
+    a process exit code (0 clean, 1 drift)."""
+    drift = 0
+    for name in proto_names or PURE_BUILDERS:
+        stem = name[:-len(".proto")]
+        out = GEN_DIR / f"{stem}_pb2.py"
+        want = _render_pure(name)
+        have = out.read_text() if out.exists() else ""
+        if have != want:
+            print(f"DRIFT: {out} does not match the pure build of "
+                  f"{name} (run python -m yadcc_tpu.api.build_protos "
+                  f"--pure)", file=sys.stderr)
+            drift = 1
+        else:
+            print(f"ok: {out.name} is byte-identical to the pure build")
+    return drift
 
 
 def build() -> None:
@@ -314,7 +340,10 @@ def build() -> None:
 
 
 if __name__ == "__main__":
-    if "--pure" in sys.argv[1:]:
-        names = [a for a in sys.argv[1:] if a != "--pure"] or None
+    flags = set(a for a in sys.argv[1:] if a.startswith("--"))
+    names = [a for a in sys.argv[1:] if not a.startswith("--")] or None
+    if "--check" in flags:
+        sys.exit(check_pure(names))
+    if "--pure" in flags:
         sys.exit(build_pure(names))
     sys.exit(build())

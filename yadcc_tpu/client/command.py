@@ -27,14 +27,21 @@ def execute_command(
         stdout=subprocess.PIPE if sink is not None else None,
         env={**os.environ, **env} if env else None,
     )
-    if sink is not None:
-        assert proc.stdout is not None
-        while True:
-            chunk = proc.stdout.read(chunk_size)
-            if not chunk:
-                break
-            sink.write(chunk)
-    return proc.wait()
+    try:
+        if sink is not None:
+            assert proc.stdout is not None
+            while True:
+                chunk = proc.stdout.read(chunk_size)
+                if not chunk:
+                    break
+                sink.write(chunk)
+        return proc.wait()
+    except BaseException:
+        # A sink failure (disk full mid-preprocess, Ctrl-C) must not
+        # orphan the child: kill and reap before propagating.
+        proc.kill()
+        proc.wait()
+        raise
 
 
 def pass_through_to_program(argv: Sequence[str]) -> int:

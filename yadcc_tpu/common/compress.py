@@ -88,7 +88,7 @@ def compress(data: bytes) -> bytes:
 _MAX_DECOMPRESSED = 1 << 31
 
 
-def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:
+def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:  # ytpu: sanitizes(size-cap)
     # max_output_size only binds STREAMING frames (no content size in
     # the header) — python-zstandard ignores it when the frame declares
     # a size, so a hostile 16KB frame declaring terabytes would attempt
@@ -104,7 +104,7 @@ def decompress(data: bytes, max_output_size: int = _MAX_DECOMPRESSED) -> bytes:
     return _ctx()[1].decompress(data, max_output_size=max_output_size)
 
 
-def try_decompress(data: bytes) -> Optional[bytes]:
+def try_decompress(data: bytes) -> Optional[bytes]:  # ytpu: sanitizes(size-cap)
     try:
         return decompress(data)
     except (CompressionError, MemoryError, ValueError):
@@ -179,7 +179,7 @@ class DecompressingDigestReader:
         self._obj = (_fallback.AnyFrameDecompressor() if zstandard is None
                      else zstandard.ZstdDecompressor().decompressobj())
 
-    def feed(self, chunk) -> bytes:
+    def feed(self, chunk) -> bytes:  # ytpu: sanitizes(size-cap)
         out = self._obj.decompress(chunk)
         self.bytes_out += len(out)
         if self.bytes_out > self._cap:
@@ -202,7 +202,7 @@ def decompress_and_digest(
     data,
     max_output_size: int = _MAX_DECOMPRESSED,
     chunk_size: int = 1 << 20,
-) -> Tuple[bytes, str]:
+) -> Tuple[bytes, str]:  # ytpu: sanitizes(size-cap, digest)
     """Single-pass (decompressed bytes, hex digest) of a complete frame.
 
     Error contract matches :func:`decompress` — corruption, truncation,

@@ -53,7 +53,7 @@ def make_multi_chunk_payload(
     return Payload((header, *chunks))
 
 
-def try_parse_multi_chunk_views(data) -> Optional[List[memoryview]]:
+def try_parse_multi_chunk_views(data) -> Optional[List[memoryview]]:  # ytpu: sanitizes(framing)
     """Zero-copy parse: chunk bodies are views into ``data``.
 
     ``data`` may be ``bytes``, ``bytearray`` or a ``memoryview`` (e.g.

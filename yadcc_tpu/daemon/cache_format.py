@@ -62,13 +62,13 @@ class CacheEntry:
 
 
 def get_cache_key(compiler_digest: str, invocation_arguments: str,
-                  source_digest: str) -> str:
+                  source_digest: str) -> str:  # ytpu: sanitizes(key-domain)
     return _KEY_PREFIX + get_cxx_task_digest(
         compiler_digest, invocation_arguments, source_digest)
 
 
 def get_jit_cache_key(env_digest: str, compile_options: bytes,
-                      computation_digest: str) -> str:
+                      computation_digest: str) -> str:  # ytpu: sanitizes(key-domain)
     return _JIT_KEY_PREFIX + get_jit_task_digest(
         env_digest, compile_options, computation_digest)
 

@@ -74,7 +74,7 @@ class _PackExecutor:
 _PACK_EXECUTOR = _PackExecutor()
 
 
-def _decompress_and_digest(data) -> Tuple[bytes, str]:
+def _decompress_and_digest(data) -> Tuple[bytes, str]:  # ytpu: sanitizes(size-cap, digest)
     """Module-level seam: the fused single-pass source intake (swapped
     for the two-pass legacy path in dataplane A/B runs)."""
     return compress.decompress_and_digest(data)
@@ -132,7 +132,7 @@ class CloudCxxCompilationTask:
 
     # -- prepare -------------------------------------------------------------
 
-    def prepare(self, compressed_source: bytes) -> None:
+    def prepare(self, compressed_source: bytes) -> None:  # ytpu: acquires(workspace)
         # Fused single pass: each decompressed piece is digested as it
         # is produced, instead of materializing the source and then
         # re-scanning all of it for the digest (the attachment arrives
@@ -204,33 +204,39 @@ class CloudCxxCompilationTask:
         buffers — the servant never flattens it (the cache-fill RPC
         joins it once at the socket)."""
         assert self.workspace is not None
-        files: Dict[str, bytes] = {}
-        patches: Dict[str, List[Tuple[int, int, bytes]]] = {}
-        needle = self.workspace.path.encode()
-        if output.exit_code == 0:
-            pool = _PACK_EXECUTOR.get()
-            jobs = []
-            for rel, content in self.workspace.read_all_files().items():
-                if rel == f"src{self._source_ext}":
-                    continue  # the input, not a product
-                ext = "." + rel.split(".", 1)[1] if "." in rel else rel
-                jobs.append((ext, pool.submit(_pack_one_output, content,
-                                              needle)))
-            for ext, fut in jobs:
-                locs, compressed = fut.result()
-                if locs:
-                    patches[ext] = locs
-                files[ext] = compressed
-        entry_future = None
-        if output.exit_code == 0 and self.cacheable:
-            entry_future = _PACK_EXECUTOR.get().submit(
-                cache_format.write_cache_entry_payload, CacheEntry(
-                    exit_code=output.exit_code,
-                    standard_output=output.standard_output,
-                    standard_error=output.standard_error,
-                    files=files,
-                    patches=patches,
-                ))
-        self.workspace.remove()
-        return files, patches, (entry_future.result()
-                                if entry_future is not None else None)
+        try:
+            files: Dict[str, bytes] = {}
+            patches: Dict[str, List[Tuple[int, int, bytes]]] = {}
+            needle = self.workspace.path.encode()
+            if output.exit_code == 0:
+                pool = _PACK_EXECUTOR.get()
+                jobs = []
+                for rel, content in \
+                        self.workspace.read_all_files().items():
+                    if rel == f"src{self._source_ext}":
+                        continue  # the input, not a product
+                    ext = "." + rel.split(".", 1)[1] if "." in rel else rel
+                    jobs.append((ext, pool.submit(_pack_one_output,
+                                                  content, needle)))
+                for ext, fut in jobs:
+                    locs, compressed = fut.result()
+                    if locs:
+                        patches[ext] = locs
+                    files[ext] = compressed
+            entry_future = None
+            if output.exit_code == 0 and self.cacheable:
+                entry_future = _PACK_EXECUTOR.get().submit(
+                    cache_format.write_cache_entry_payload, CacheEntry(
+                        exit_code=output.exit_code,
+                        standard_output=output.standard_output,
+                        standard_error=output.standard_error,
+                        files=files,
+                        patches=patches,
+                    ))
+            return files, patches, (entry_future.result()
+                                    if entry_future is not None else None)
+        finally:
+            # A pack failure (pool shutdown mid-stop, compressor error)
+            # must still reclaim the RAM-backed workspace — the waiter
+            # thread reports the exception, nothing retries this task.
+            self.workspace.remove()

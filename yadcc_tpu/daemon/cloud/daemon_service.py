@@ -110,7 +110,7 @@ class DaemonService:
         s.add("FreeTask", api.daemon.FreeDaemonTaskRequest, self.FreeTask)
         return s
 
-    def _verify(self, token: str) -> None:
+    def _verify(self, token: str) -> None:  # ytpu: sanitizes(authz)
         # Fail CLOSED: until the first heartbeat response delivers the
         # scheduler's rotating token window, this servant serves nobody.
         # An empty set must not accept-all — QueueCxxCompilationTask
@@ -129,7 +129,7 @@ class DaemonService:
     # -- RPC handlers -------------------------------------------------------
 
     def QueueCxxCompilationTask(self, req, attachment: bytes,
-                                ctx: RpcContext):
+                                ctx: RpcContext):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)
         if req.compression_algorithm != \
                 api.daemon.COMPRESSION_ALGORITHM_ZSTD:
@@ -151,54 +151,68 @@ class DaemonService:
             ignore_timestamp_macros=req.ignore_timestamp_macros,
         )
         try:
-            task.prepare(attachment)
-        except ValueError as e:
-            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT, str(e))
+            try:
+                task.prepare(attachment)
+            except ValueError as e:
+                raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                               str(e))
 
-        # Defensive dedup: an identical task already running here can
-        # simply be joined (the delegate-side dedup usually catches this
-        # first via ReferenceTask).
-        existing = self.engine.find_task_by_digest(task.task_digest)
-        if existing is not None and self.engine.reference_task(existing):
-            task.workspace.remove()
-            return api.daemon.QueueCxxCompilationTaskResponse(
-                task_id=existing)
+            # Defensive dedup: an identical task already running here
+            # can simply be joined (the delegate-side dedup usually
+            # catches this first via ReferenceTask).
+            existing = self.engine.find_task_by_digest(task.task_digest)
+            if existing is not None and \
+                    self.engine.reference_task(existing):
+                task.workspace.remove()
+                return api.daemon.QueueCxxCompilationTaskResponse(
+                    task_id=existing)
 
-        def on_completion(task_id: int, output):
-            files, patches, cache_entry = task.collect_outputs(output)
-            result = _TaskResult(
-                exit_code=output.exit_code,
-                standard_output=output.standard_output,
-                standard_error=output.standard_error,
-                files=files,
-                patches=patches,
+            def on_completion(task_id: int, output):
+                files, patches, cache_entry = task.collect_outputs(output)
+                result = _TaskResult(
+                    exit_code=output.exit_code,
+                    standard_output=output.standard_output,
+                    standard_error=output.standard_error,
+                    files=files,
+                    patches=patches,
+                )
+                with self._lock:
+                    self._results[task_id] = result
+                if cache_entry is not None and \
+                        self.cache_writer is not None:
+                    self.cache_writer.async_write(task.cache_key,
+                                                  cache_entry)
+
+            task_id = self.engine.try_queue_task(
+                grant_id=req.task_grant_id,
+                digest=task.task_digest,
+                cmdline=task.cmdline,
+                on_completion=on_completion,
+                # Compile INSIDE the padded workspace: -g builds then
+                # embed it as DW_AT_comp_dir, which patch-location
+                # discovery finds and the client rewrites to its own
+                # directory — debuggers on the client machine resolve
+                # relative source names (reference pads the workspace
+                # for exactly this, remote_task/
+                # cxx_compilation_task.cc:78-92).
+                cwd=task.workspace.path,
             )
-            with self._lock:
-                self._results[task_id] = result
-            if cache_entry is not None and self.cache_writer is not None:
-                self.cache_writer.async_write(task.cache_key, cache_entry)
-
-        task_id = self.engine.try_queue_task(
-            grant_id=req.task_grant_id,
-            digest=task.task_digest,
-            cmdline=task.cmdline,
-            on_completion=on_completion,
-            # Compile INSIDE the padded workspace: -g builds then embed
-            # it as DW_AT_comp_dir, which patch-location discovery finds
-            # and the client rewrites to its own directory — debuggers
-            # on the client machine resolve relative source names
-            # (reference pads the workspace for exactly this,
-            # remote_task/cxx_compilation_task.cc:78-92).
-            cwd=task.workspace.path,
-        )
-        if task_id is None:
-            task.workspace.remove()
-            raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
-                           "servant saturated")
+            if task_id is None:
+                raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
+                               "servant saturated")
+        except BaseException:
+            # The RAM-backed workspace must die with the failed
+            # submission — admission rejections, RPC mapping, and any
+            # unexpected engine error alike (a handler crash turns
+            # into a status frame upstream; nothing else would ever
+            # reclaim /dev/shm space).
+            if task.workspace is not None:
+                task.workspace.remove()
+            raise
         return api.daemon.QueueCxxCompilationTaskResponse(task_id=task_id)
 
     def QueueJitCompilationTask(self, req, attachment: bytes,
-                                ctx: RpcContext):
+                                ctx: RpcContext):  # ytpu: untrusted(req, attachment)
         """Second-workload twin of QueueCxxCompilationTask: an XLA jit
         compile lands on the same engine (admission, refcounts,
         kill-on-lease-expiry) through the same generic wait/free RPC
@@ -226,59 +240,71 @@ class DaemonService:
             disallow_cache_fill=req.disallow_cache_fill,
         )
         try:
-            task.prepare(attachment)
-        except ValueError as e:
-            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT, str(e))
+            try:
+                task.prepare(attachment)
+            except ValueError as e:
+                raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                               str(e))
 
-        # Defensive dedup, same as cxx: the delegate-side join usually
-        # catches duplicate compilations first, but N delegates racing
-        # the same cold model step can all be granted before any of
-        # them shows up in the running-task snapshot.
-        existing = self.engine.find_task_by_digest(task.task_digest)
-        if existing is not None and self.engine.reference_task(existing):
-            task.workspace.remove()
-            return api.jit.QueueJitCompilationTaskResponse(
-                task_id=existing)
+            # Defensive dedup, same as cxx: the delegate-side join
+            # usually catches duplicate compilations first, but N
+            # delegates racing the same cold model step can all be
+            # granted before any of them shows up in the running-task
+            # snapshot.
+            existing = self.engine.find_task_by_digest(task.task_digest)
+            if existing is not None and \
+                    self.engine.reference_task(existing):
+                task.workspace.remove()
+                return api.jit.QueueJitCompilationTaskResponse(
+                    task_id=existing)
 
-        def on_completion(task_id: int, output):
-            files, patches, cache_entry = task.collect_outputs(output)
-            result = _TaskResult(
-                exit_code=output.exit_code,
-                standard_output=output.standard_output,
-                standard_error=output.standard_error,
-                files=files,
-                patches=patches,
+            def on_completion(task_id: int, output):
+                files, patches, cache_entry = task.collect_outputs(output)
+                result = _TaskResult(
+                    exit_code=output.exit_code,
+                    standard_output=output.standard_output,
+                    standard_error=output.standard_error,
+                    files=files,
+                    patches=patches,
+                )
+                with self._lock:
+                    self._results[task_id] = result
+                if cache_entry is not None and \
+                        self.cache_writer is not None:
+                    self.cache_writer.async_write(task.cache_key,
+                                                  cache_entry)
+
+            task_id = self.engine.try_queue_task(
+                grant_id=req.task_grant_id,
+                digest=task.task_digest,
+                cmdline=task.cmdline,
+                on_completion=on_completion,
+                # The worker needs the package importable from the
+                # engine's `sh -c` launch; serialized executables embed
+                # no paths, so no padded workspace (see
+                # cloud/jit_task.py).
+                env=task.worker_env(),
+                cwd=task.workspace.path,
             )
-            with self._lock:
-                self._results[task_id] = result
-            if cache_entry is not None and self.cache_writer is not None:
-                self.cache_writer.async_write(task.cache_key, cache_entry)
-
-        task_id = self.engine.try_queue_task(
-            grant_id=req.task_grant_id,
-            digest=task.task_digest,
-            cmdline=task.cmdline,
-            on_completion=on_completion,
-            # The worker needs the package importable from the engine's
-            # `sh -c` launch; serialized executables embed no paths, so
-            # no padded workspace (see cloud/jit_task.py).
-            env=task.worker_env(),
-            cwd=task.workspace.path,
-        )
-        if task_id is None:
-            task.workspace.remove()
-            raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
-                           "servant saturated")
+            if task_id is None:
+                raise RpcError(api.daemon.DAEMON_STATUS_HEAVILY_LOADED,
+                               "servant saturated")
+        except BaseException:
+            # Same cleanup contract as the cxx handler: no exception
+            # path may leak the staged workspace.
+            if task.workspace is not None:
+                task.workspace.remove()
+            raise
         return api.jit.QueueJitCompilationTaskResponse(task_id=task_id)
 
-    def ReferenceTask(self, req, attachment, ctx):
+    def ReferenceTask(self, req, attachment, ctx):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)
         if not self.engine.reference_task(req.task_id):
             raise RpcError(api.daemon.DAEMON_STATUS_TASK_NOT_FOUND,
                            str(req.task_id))
         return api.daemon.ReferenceTaskResponse()
 
-    def WaitForCompilationOutput(self, req, attachment, ctx: RpcContext):
+    def WaitForCompilationOutput(self, req, attachment, ctx: RpcContext):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)
         if api.daemon.COMPRESSION_ALGORITHM_ZSTD not in list(
                 req.acceptable_compression_algorithms or
@@ -315,7 +341,7 @@ class DaemonService:
             result.files)
         return resp
 
-    def FreeTask(self, req, attachment, ctx):
+    def FreeTask(self, req, attachment, ctx):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)
         if self.engine.free_task(req.task_id):
             # Fully released: no joined waiter still needs the result.

@@ -78,7 +78,7 @@ class CloudJitCompilationTask:
 
     # -- prepare -------------------------------------------------------------
 
-    def prepare(self, compressed_computation: bytes) -> None:
+    def prepare(self, compressed_computation: bytes) -> None:  # ytpu: acquires(workspace)
         try:
             computation, self.computation_digest = \
                 compress.decompress_and_digest(compressed_computation)
@@ -143,27 +143,31 @@ class CloudJitCompilationTask:
         this callback with the SIGKILL exit code and the workspace must
         not leak."""
         assert self.workspace is not None
-        files: Dict[str, bytes] = {}
-        artifact = None
-        if output.exit_code == 0:
-            try:
-                with open(f"{self.workspace.path}/artifact.bin",
-                          "rb") as fp:
-                    artifact = fp.read()
-            except OSError:
-                artifact = None
-        entry_future = None
-        if artifact is not None:
-            files[ARTIFACT_KEY] = compress.compress(artifact)
-            if not self.disallow_cache_fill:
-                entry_future = _PACK_EXECUTOR.get().submit(
-                    cache_format.write_cache_entry_payload, CacheEntry(
-                        exit_code=output.exit_code,
-                        standard_output=output.standard_output,
-                        standard_error=output.standard_error,
-                        files=files,
-                        kind=cache_format.KIND_JIT,
-                    ))
-        self.workspace.remove()
-        return files, {}, (entry_future.result()
-                           if entry_future is not None else None)
+        try:
+            files: Dict[str, bytes] = {}
+            artifact = None
+            if output.exit_code == 0:
+                try:
+                    with open(f"{self.workspace.path}/artifact.bin",
+                              "rb") as fp:
+                        artifact = fp.read()
+                except OSError:
+                    artifact = None
+            entry_future = None
+            if artifact is not None:
+                files[ARTIFACT_KEY] = compress.compress(artifact)
+                if not self.disallow_cache_fill:
+                    entry_future = _PACK_EXECUTOR.get().submit(
+                        cache_format.write_cache_entry_payload, CacheEntry(
+                            exit_code=output.exit_code,
+                            standard_output=output.standard_output,
+                            standard_error=output.standard_error,
+                            files=files,
+                            kind=cache_format.KIND_JIT,
+                        ))
+            return files, {}, (entry_future.result()
+                               if entry_future is not None else None)
+        finally:
+            # Compress/pack failures must not leak the staging dir —
+            # same contract as the killed-mid-compile case.
+            self.workspace.remove()

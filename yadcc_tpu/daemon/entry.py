@@ -111,12 +111,17 @@ def _guess_local_ip(scheduler_uri: str) -> str:
     host, _, port = target.rpartition(":")
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect((host or "8.8.8.8", int(port or 443)))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
     except OSError:
         return "127.0.0.1"
+    try:
+        s.connect((host or "8.8.8.8", int(port or 443)))
+        return s.getsockname()[0]
+    except OSError:
+        # The fd must not leak on the failure path: a daemon restarting
+        # through flaky DNS used to burn one fd per attempt.
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 def daemon_start(args) -> None:

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ... import api
+from ...common.limits import checked_attachment
 from .. import cache_format, packing
 from ..cache_format import get_cache_key
 from ..task_digest import get_cxx_task_digest
@@ -133,6 +134,10 @@ def make_cxx_task(msg: api.local.SubmitCxxTaskRequest,
         invocation_arguments=msg.compiler_invocation_arguments,
         cache_control=msg.cache_control,
         compiler_digest=digest,
-        compressed_source=compressed_source,
+        # Wire-cap the attachment at intake: no servant will accept a
+        # bigger one, so queuing it only burns delegate memory and
+        # retries (taint-registry proves every registered kind does
+        # this).
+        compressed_source=checked_attachment(compressed_source),
         ignore_timestamp_macros=msg.ignore_timestamp_macros,
     )

@@ -36,6 +36,7 @@ from google.protobuf import json_format
 from ... import api
 from ...common import multi_chunk
 from ...common.hashing import digest_keyed
+from ...common.limits import BodyTooLarge, checked_content_length, clamp_wait_s
 from ...common.payload import Payload
 from ...utils.logging import get_logger
 from ...version import BUILT_AT, VERSION_FOR_UPGRADE
@@ -53,7 +54,7 @@ _SHIM_KEY_PREFIX = "ytpu-jitext1-"
 _SHIM_KEY_DOMAIN = "ytpu-jit-extcache"
 
 
-def shim_cache_key(client_key: str) -> str:
+def shim_cache_key(client_key: str) -> str:  # ytpu: sanitizes(key-domain)
     return _SHIM_KEY_PREFIX + digest_keyed(_SHIM_KEY_DOMAIN,
                                            client_key.encode())
 
@@ -135,8 +136,18 @@ class LocalHttpService:
                 else:
                     self._reply(404)
 
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
+            def do_POST(self):  # ytpu: untrusted(self.headers, self.rfile)
+                # Cap BEFORE buffering: any local process can open this
+                # socket, and a claimed Content-Length of terabytes
+                # must be refused at the header, not handed to the
+                # allocator.  413 mirrors the cap the servants enforce
+                # on the decompression side.
+                try:
+                    length = checked_content_length(
+                        self.headers.get("Content-Length", 0))
+                except BodyTooLarge:
+                    self._reply(413, b'{"error":"body exceeds wire cap"}')
+                    return
                 body = self.rfile.read(length) if length else b""
                 try:
                     service._route_post(self, self.path, body)
@@ -164,16 +175,19 @@ class LocalHttpService:
 
     # -- routing -------------------------------------------------------------
 
-    def _route_post(self, handler, path: str, body: bytes) -> None:
+    def _route_post(self, handler, path: str, body: bytes) -> None:  # ytpu: untrusted(body)
         if path == "/local/ask_to_leave":
             handler._reply(200, _to_json(api.local.AskToLeaveResponse()))
             self.on_leave()
             return
         if path == "/local/acquire_quota":
             req = _from_json(api.local.AcquireQuotaRequest, body)
+            # Clamp the client-supplied window: an unbounded value
+            # parked this serving thread (and its quota waiter slot)
+            # for arbitrary time.  Clients long-poll and re-ask.
             ok = self.monitor.wait_for_running_new_task_permission(
                 req.requestor_pid, req.lightweight_task,
-                req.milliseconds_to_wait / 1000.0)
+                clamp_wait_s(req.milliseconds_to_wait))
             if ok:
                 handler._reply(200,
                                _to_json(api.local.AcquireQuotaResponse()))
@@ -213,7 +227,7 @@ class LocalHttpService:
 
     # -- generic task submit/wait (one flow for every registered kind) -------
 
-    def _submit_task(self, handler, task_type, body: bytes) -> None:
+    def _submit_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
         # Views: the (possibly multi-MB) attachment chunk stays a view
         # into the request body all the way to the servant RPC.
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
@@ -235,7 +249,7 @@ class LocalHttpService:
         handler._reply(200, _to_json(
             api.local.SubmitCxxTaskResponse(task_id=task_id)))
 
-    def _wait_for_task(self, handler, task_type, body: bytes) -> None:
+    def _wait_for_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
         req = _from_json(task_type.wait_request_cls, body)
         result = self.dispatcher.wait_for_task(
             req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
@@ -253,7 +267,7 @@ class LocalHttpService:
 
     # -- persistent-compile-cache shim routes --------------------------------
 
-    def _jit_cache_get(self, handler, body: bytes) -> None:
+    def _jit_cache_get(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)
         req = _from_json(api.jit.JitCacheGetRequest, body)
         if self.cache_reader is None or not req.key:
             handler._reply(404)
@@ -268,7 +282,7 @@ class LocalHttpService:
                 [_to_json(api.jit.JitCacheGetResponse()), data]),
             content_type="application/octet-stream")
 
-    def _jit_cache_put(self, handler, body: bytes) -> None:
+    def _jit_cache_put(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
         if not chunks or len(chunks) != 2:
             handler._reply(400, b'{"error":"expect json+value chunks"}')

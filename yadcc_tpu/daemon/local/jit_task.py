@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ... import api
+from ...common.limits import checked_attachment
 from ...jit.env import jit_env_digest
 from .. import cache_format, packing
 from ..cache_format import get_jit_cache_key
@@ -123,5 +124,6 @@ def make_jit_task(msg: "api.jit.SubmitJitTaskRequest",
         backend=msg.backend,
         jaxlib_version=msg.jaxlib_version,
         cache_control=msg.cache_control,
-        compressed_computation=compressed_computation,
+        # Same wire-cap-at-intake contract as make_cxx_task.
+        compressed_computation=checked_attachment(compressed_computation),
     )

@@ -16,7 +16,7 @@ _JIT_DOMAIN = "ytpu-jit-task"
 
 
 def get_cxx_task_digest(compiler_digest: str, invocation_arguments: str,
-                        source_digest: str) -> str:
+                        source_digest: str) -> str:  # ytpu: sanitizes(key-domain)
     return digest_keyed(
         _DOMAIN,
         compiler_digest.encode(),
@@ -26,7 +26,7 @@ def get_cxx_task_digest(compiler_digest: str, invocation_arguments: str,
 
 
 def get_jit_task_digest(env_digest: str, compile_options: bytes,
-                        computation_digest: str) -> str:
+                        computation_digest: str) -> str:  # ytpu: sanitizes(key-domain)
     """Jit analogue of the (compiler, args, source) triple:
     (jit environment, serialized CompileOptions, lowered StableHLO) —
     each the full determinant of the compile's output in its slot.

@@ -139,7 +139,7 @@ def last_server_inner_s() -> Optional[float]:
     return getattr(_tls, "server_inner_s", None)
 
 
-def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:
+def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:  # ytpu: untrusted(data)
     """Server-side: decode a request frame, run the handler, encode reply.
 
     Never raises: malformed frames, undecodable messages and handler
