@@ -401,6 +401,26 @@ def main() -> None:
     except Exception:
         jit_cps = None
 
+    # Fan-out workload canaries (tools/cluster_sim --workload aot /
+    # autotune, doc/workloads.md): topology results delivered per
+    # second through the fan-out path, and the sweep corpus' dedup
+    # ratio (fraction of child resolutions that cost no servant
+    # compile — the cluster-wide "measure once" claim).
+    try:
+        from yadcc_tpu.tools.cluster_sim import \
+            quick_aot_fanout_compiles_per_sec
+
+        aot_cps = round(quick_aot_fanout_compiles_per_sec(), 1)
+    except Exception:
+        aot_cps = None
+    try:
+        from yadcc_tpu.tools.cluster_sim import \
+            quick_autotune_sweep_dedup_ratio
+
+        autotune_dedup = round(quick_autotune_sweep_dedup_ratio(), 3)
+    except Exception:
+        autotune_dedup = None
+
     # Hostile-world survival canaries (tools/scenarios.py,
     # doc/robustness.md): the p99 latency of an explicit REJECT verdict
     # under a smoke 4x-overload ladder storm (a rejection is an
@@ -415,6 +435,11 @@ def main() -> None:
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 7 (r12+): adds `aot_fanout_compiles_per_sec` and
+        # `autotune_sweep_dedup_ratio` — the fan-out workload canaries
+        # (tools/cluster_sim --workload aot / autotune smoke runs;
+        # doc/benchmarks.md "Fan-out workloads").  Every v6 field is
+        # still emitted.
         # Version 6 (r11+): adds `overload_reject_p99_ms` and
         # `survival_compile_success_rate` from the hostile-world
         # scenario harness (tools/scenarios.py smoke runs of the
@@ -435,7 +460,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 6,
+        "harness_version": 7,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -470,6 +495,8 @@ def main() -> None:
         "dataplane_mb_per_sec": dataplane_mb,
         # (v5 documented this field but never emitted it — fixed in v6.)
         "jit_compiles_per_sec": jit_cps,
+        "aot_fanout_compiles_per_sec": aot_cps,
+        "autotune_sweep_dedup_ratio": autotune_dedup,
         "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
         "survival_compile_success_rate": hostile.get(
             "survival_compile_success_rate"),

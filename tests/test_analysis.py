@@ -698,6 +698,63 @@ BAD = TaskType(kind="bad", make_task=lambda m, a: make_bad_task(m, a))
     assert len(tr) == 1 and "'bad'" in tr[0].message
 
 
+def test_taint_registry_fanout_kind_bypassing_checked_attachment(
+        tmp_path):
+    """Workloads 3-4 regression (ISSUE 8): a fifth kind registered with
+    a factory that skips limits.checked_attachment must fail lint —
+    the real four-row registry shape, with one bypassing row."""
+    findings, _ = run_snippet(tmp_path, """
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TaskType:
+    kind: str
+    make_task: object
+
+
+def checked_attachment(data):  # ytpu: sanitizes(size-cap)
+    return data
+
+
+def make_aot_task(msg, att):
+    return checked_attachment(att)
+
+
+def make_autotune_task(msg, att):
+    return checked_attachment(att)
+
+
+def make_video_task(msg, att):
+    return att  # the bypass: no size-cap before queueing
+
+
+REGISTRY = [
+    TaskType(kind="aot", make_task=lambda m, a: make_aot_task(m, a)),
+    TaskType(kind="autotune",
+             make_task=lambda m, a: make_autotune_task(m, a)),
+    TaskType(kind="video",
+             make_task=lambda m, a: make_video_task(m, a)),
+]
+""", subdir="daemon")
+    tr = live(findings, "taint-registry")
+    assert len(tr) == 1 and "'video'" in tr[0].message
+
+
+def test_production_registry_passes_taint_registry():
+    """The shipped four-kind registry must satisfy taint-registry by
+    construction: every factory routes its attachment through
+    limits.checked_attachment."""
+    findings, _ = analyze_paths([PKG_DIR], _package_config())
+    assert not live(findings, "taint-registry")
+    # And the registry really has all four kinds registered.
+    from yadcc_tpu.daemon.local.file_digest_cache import FileDigestCache
+    from yadcc_tpu.daemon.local.task_registry import default_registry
+
+    assert default_registry(FileDigestCache()).kinds() == \
+        ["aot", "autotune", "cxx", "jit"]
+
+
 # ---------------------------------------------------------------------------
 # resource lifecycle (v2)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,873 @@
+"""Workloads 3 & 4: AOT multi-topology builds and autotune sweeps —
+one logical submission fanned out to many servants (jit/fanout.py,
+doc/workloads.md).
+
+Covers the fan-out machinery in isolation (width bound, fairness
+splitting, retry/straggler semantics against fake dispatch callables),
+the new cache-entry kinds and key namespaces, factory validation, the
+servant-side gating edges, and the ISSUE 8 acceptance criteria end to
+end on a loopback cluster: an N=4 AOT submission with 1 pre-cached
+topology produces exactly 3 servant compiles (partial-hit proven via
+``actually_run``), a second identical submission produces 0, and an
+autotune sweep's winning config is served from the sweep-level cache
+to a second delegate with zero fan-out.
+
+Every cluster test runs with YTPU_JIT_FAKE_WORKER=1: deterministic
+digest-derived artifacts/scores — the farm is under test, not XLA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+from google.protobuf import json_format
+
+from yadcc_tpu import api
+from yadcc_tpu.common import compress, multi_chunk
+from yadcc_tpu.common.hashing import digest_bytes, digest_file
+from yadcc_tpu.daemon import cache_format
+from yadcc_tpu.daemon.cache_format import (
+    CacheEntry,
+    get_aot_cache_key,
+    get_autotune_cache_key,
+    get_autotune_sweep_key,
+    get_jit_cache_key,
+    try_parse_cache_entry,
+    write_cache_entry,
+)
+from yadcc_tpu.jit import fanout
+from yadcc_tpu.jit.autotune import SearchSpace
+from yadcc_tpu.jit.env import local_jit_environment
+from yadcc_tpu.testing import LocalCluster, make_fake_compiler
+
+from .conftest import post_local
+
+HLO = b"module @fanout_mod { func.func public @main() { return } }"
+KERNEL = b"def k(x_ref, o_ref):  # {block_m} {block_n}\n    pass\n"
+
+
+def _topo(*shape):
+    count = 1
+    for d in shape:
+        count *= d
+    return fanout.TopologySpec(mesh_shape=tuple(shape),
+                               device_count=count).validate()
+
+
+def make_aot_parent(hlo=HLO, topologies=None, cache_control=1, pid=1):
+    from yadcc_tpu.daemon.local.aot_task import AotBuildTask
+
+    env = local_jit_environment("cpu")
+    return AotBuildTask(
+        requestor_pid=pid,
+        computation_digest=digest_bytes(hlo),
+        backend="cpu",
+        jaxlib_version=env.jaxlib_version,
+        cache_control=cache_control,
+        topologies=list(topologies or [_topo(1), _topo(2)]),
+        compressed_computation=compress.compress(hlo),
+    )
+
+
+def make_sweep_parent(kernel=KERNEL, configs=None, width=2,
+                      cache_control=1, pid=1):
+    from yadcc_tpu.daemon.local.autotune_task import AutotuneSweepTask
+
+    env = local_jit_environment("cpu")
+    configs = configs or SearchSpace.of(block_m=[64, 128],
+                                        block_n=[64, 128]).expand()
+    return AutotuneSweepTask(
+        requestor_pid=pid,
+        kernel_digest=digest_bytes(kernel),
+        backend="cpu",
+        jaxlib_version=env.jaxlib_version,
+        cache_control=cache_control,
+        configs=list(configs),
+        fanout_width=width,
+        compressed_kernel=compress.compress(kernel),
+    )
+
+
+# -- fan-out machinery in isolation -------------------------------------------
+
+
+class TestWidthBound:
+    def test_checked_width(self):
+        assert fanout.checked_fanout_width(1) == 1
+        assert fanout.checked_fanout_width(64) == 64
+        with pytest.raises(ValueError):
+            fanout.checked_fanout_width(0)
+        with pytest.raises(ValueError):
+            fanout.checked_fanout_width(65)
+
+    def test_env_override_validated(self, monkeypatch):
+        monkeypatch.setenv("YTPU_FANOUT_MAX_WIDTH", "8")
+        with pytest.raises(ValueError):
+            fanout.checked_fanout_width(9)
+        # A typo must not turn the bound off.
+        monkeypatch.setenv("YTPU_FANOUT_MAX_WIDTH", "lots")
+        assert fanout.max_fanout_width() == \
+            fanout.DEFAULT_MAX_FANOUT_WIDTH
+        monkeypatch.setenv("YTPU_FANOUT_MAX_WIDTH", "-3")
+        assert fanout.max_fanout_width() == \
+            fanout.DEFAULT_MAX_FANOUT_WIDTH
+
+
+class TestTopologySpec:
+    def test_validate_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            fanout.TopologySpec(mesh_shape=(), device_count=0).validate()
+        with pytest.raises(ValueError):
+            fanout.TopologySpec(mesh_shape=(2, 2, 2),
+                                device_count=8).validate()
+        with pytest.raises(ValueError):
+            fanout.TopologySpec(mesh_shape=(2, 4),
+                                device_count=6).validate()
+        with pytest.raises(ValueError):
+            fanout.TopologySpec(mesh_shape=(0,), device_count=0).validate()
+
+    def test_digest_every_component_load_bearing(self):
+        base = _topo(2, 4).digest()
+        assert _topo(4, 2).digest() != base
+        assert _topo(8).digest() != base
+        assert fanout.TopologySpec(
+            mesh_shape=(2, 4), device_count=8,
+            compile_options=b"opts").digest() != base
+        assert _topo(2, 4).digest() == base  # stable
+
+    def test_tag_is_shape_plus_digest_head(self):
+        t = _topo(2, 4)
+        assert t.tag().startswith("2x4-")
+        assert t.tag() == t.tag()
+
+
+class TestConfigSlicing:
+    def test_slices_are_deterministic_and_cover(self):
+        configs = [fanout.canonical_config({"b": i}) for i in range(10)]
+        slices = fanout.slice_configs(configs, 3)
+        assert [len(s) for s in slices] == [4, 3, 3]
+        assert [c for s in slices for c in s] == configs
+        assert fanout.slice_configs(configs, 3) == slices
+
+    def test_width_clamped_to_configs(self):
+        configs = [fanout.canonical_config({"b": i}) for i in range(2)]
+        assert len(fanout.slice_configs(configs, 8)) == 2
+
+    def test_space_digest_is_order_sensitive(self):
+        a = [fanout.canonical_config({"b": i}) for i in range(3)]
+        assert fanout.search_space_digest(a) != \
+            fanout.search_space_digest(list(reversed(a)))
+        assert fanout.slice_digest(a[:2]) != fanout.slice_digest(a[1:])
+
+
+class TestFairnessSplit:
+    def test_children_split_parent_weight(self):
+        parent = make_aot_parent(topologies=[_topo(n) for n in (1, 2, 4,
+                                                                8)])
+        children = [c for _, c in parent.expand_children()]
+        assert len(children) == 4
+        for c in children:
+            assert c.fairness_weight == pytest.approx(0.25)
+            # Same requestor => same fairness key as the parent.
+            assert c.fairness_key() == parent.fairness_key()
+
+    def test_search_space_expansion(self):
+        space = SearchSpace.of(block_m=[64, 128], grid=[1, 2])
+        cfgs = space.expand()
+        assert len(cfgs) == 4
+        assert all(isinstance(json.loads(c), dict) for c in cfgs)
+        # Deterministic order: digests stable across processes.
+        assert cfgs == space.expand()
+
+
+def _fake_result(exit_code=0, **kw):
+    base = dict(exit_code=exit_code, standard_error=b"", files={},
+                from_cache=False, reused_existing=False)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+class TestRunFanout:
+    def _driver(self, script):
+        """queue/wait/free fakes: ``script[key]`` is a list of results
+        popped per attempt."""
+        state = {"next_id": 0, "by_id": {}, "freed": []}
+
+        def queue(task):
+            state["next_id"] += 1
+            state["by_id"][state["next_id"]] = task
+            return state["next_id"]
+
+        def wait(task_id, timeout_s):
+            task = state["by_id"][task_id]
+            return script[task.key].pop(0)
+
+        def free(task_id):
+            state["freed"].append(task_id)
+
+        return queue, wait, free, state
+
+    def test_infra_failure_retries_then_succeeds(self):
+        script = {"a": [_fake_result(-1), _fake_result(0)],
+                  "b": [_fake_result(0)]}
+        tasks = [(k, SimpleNamespace(key=k)) for k in ("a", "b")]
+        queue, wait, free, state = self._driver(script)
+        sleeps = []
+        outcomes = fanout.run_fanout(
+            tasks, queue=queue, wait=wait, free=free,
+            sleep=sleeps.append)
+        assert outcomes["a"].verdict.status == fanout.STATUS_OK
+        assert outcomes["a"].verdict.attempts == 2
+        assert outcomes["b"].verdict.attempts == 1
+        assert len(sleeps) == 1 and sleeps[0] > 0  # backoff engaged
+        assert len(state["freed"]) == 3  # every attempt freed
+
+    def test_deterministic_failure_never_retries(self):
+        script = {"a": [_fake_result(2)]}
+        queue, wait, free, _ = self._driver(script)
+        outcomes = fanout.run_fanout(
+            [("a", SimpleNamespace(key="a"))],
+            queue=queue, wait=wait, free=free, sleep=lambda s: None)
+        v = outcomes["a"].verdict
+        assert v.status == fanout.STATUS_FAILED
+        assert v.exit_code == 2 and v.attempts == 1
+
+    def test_straggler_exhausts_attempts_parent_completes(self):
+        script = {"a": [None, None, None], "b": [_fake_result(0)]}
+        queue, wait, free, _ = self._driver(script)
+        outcomes = fanout.run_fanout(
+            [(k, SimpleNamespace(key=k)) for k in ("a", "b")],
+            queue=queue, wait=wait, free=free, sleep=lambda s: None,
+            policy=fanout.FanoutPolicy(max_attempts=3))
+        assert outcomes["a"].verdict.status == fanout.STATUS_INFRA
+        assert outcomes["a"].verdict.attempts == 3
+        assert outcomes["b"].verdict.status == fanout.STATUS_OK
+        assert fanout.aggregate_exit_code(outcomes) == -1
+
+    def test_abort_stops_retries(self):
+        script = {"a": [_fake_result(-1)]}
+        queue, wait, free, _ = self._driver(script)
+        outcomes = fanout.run_fanout(
+            [("a", SimpleNamespace(key="a"))],
+            queue=queue, wait=wait, free=free, sleep=lambda s: None,
+            aborted=lambda: True)
+        assert outcomes["a"].verdict.status == fanout.STATUS_INFRA
+        assert outcomes["a"].verdict.attempts == 1
+
+    def test_cached_and_joined_statuses(self):
+        script = {
+            "a": [_fake_result(0, from_cache=True)],
+            "b": [_fake_result(0, reused_existing=True)],
+        }
+        queue, wait, free, _ = self._driver(script)
+        outcomes = fanout.run_fanout(
+            [(k, SimpleNamespace(key=k)) for k in ("a", "b")],
+            queue=queue, wait=wait, free=free, sleep=lambda s: None)
+        assert outcomes["a"].verdict.status == fanout.STATUS_CACHED
+        assert outcomes["b"].verdict.status == fanout.STATUS_JOINED
+        assert fanout.aggregate_exit_code(outcomes) == 0
+
+
+# -- cache-entry kinds / key namespaces ---------------------------------------
+
+
+class TestFanoutCacheKinds:
+    def test_key_namespaces_disjoint(self):
+        aot = get_aot_cache_key("e", "t", "c")
+        tune = get_autotune_cache_key("e", "s", "k")
+        sweep = get_autotune_sweep_key("e", "s", "k")
+        jit = get_jit_cache_key("e", b"o", "c")
+        assert aot.startswith("ytpu-aot1-entry-")
+        assert tune.startswith("ytpu-tune1-entry-")
+        assert sweep.startswith("ytpu-tune1-entry-")
+        assert len({aot, tune, sweep, jit}) == 4
+
+    def test_slice_vs_sweep_keys_domain_separated(self):
+        # Identical component strings must never collide across the
+        # two autotune key levels.
+        assert get_autotune_cache_key("e", "x", "k") != \
+            get_autotune_sweep_key("e", "x", "k")
+
+    def test_kind_gating_both_new_kinds(self):
+        aot_blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".xla": b"a"}, kind=cache_format.KIND_AOT))
+        tune_blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".cfg": b"r"}, kind=cache_format.KIND_AUTOTUNE))
+        assert try_parse_cache_entry(
+            aot_blob, expect_kind=cache_format.KIND_AOT) is not None
+        assert try_parse_cache_entry(
+            tune_blob,
+            expect_kind=cache_format.KIND_AUTOTUNE) is not None
+        # Cross-kind reads are misses in every direction.
+        assert try_parse_cache_entry(aot_blob) is None
+        assert try_parse_cache_entry(
+            aot_blob, expect_kind=cache_format.KIND_AUTOTUNE) is None
+        assert try_parse_cache_entry(
+            tune_blob, expect_kind=cache_format.KIND_AOT) is None
+
+    def test_sweep_parse_rejects_slice_shaped_entry(self):
+        """A slice record entry (``.cfg``) must not parse as a sweep
+        verdict even under the right kind."""
+        parent = make_sweep_parent()
+        slice_blob = write_cache_entry(CacheEntry(
+            exit_code=0, standard_output=b"", standard_error=b"",
+            files={".cfg": compress.compress(b'{"config":{},"score":1}')},
+            kind=cache_format.KIND_AUTOTUNE))
+        assert parent.parse_cache_entry(slice_blob) is None
+
+
+# -- factory validation -------------------------------------------------------
+
+
+class TestMakeAotTask:
+    def _msg(self, n_topologies=2, **kw):
+        env = local_jit_environment("cpu")
+        msg = api.fanout.SubmitAotTaskRequest(
+            requestor_process_id=1,
+            computation_digest=kw.get("digest", digest_bytes(HLO)),
+            backend=kw.get("backend", "cpu"),
+            jaxlib_version=kw.get("jaxlib_version", env.jaxlib_version),
+            cache_control=1)
+        for n in range(1, n_topologies + 1):
+            t = msg.topologies.add(device_count=n)
+            t.mesh_shape.append(n)
+        return msg
+
+    def test_missing_environment_raises(self):
+        from yadcc_tpu.daemon.local.aot_task import make_aot_task
+        from yadcc_tpu.daemon.local.jit_task import NeedJitEnvironment
+
+        with pytest.raises(NeedJitEnvironment):
+            make_aot_task(self._msg(jaxlib_version=""), b"")
+
+    def test_empty_and_oversized_fanouts_rejected(self):
+        from yadcc_tpu.daemon.local.aot_task import make_aot_task
+
+        with pytest.raises(ValueError):
+            make_aot_task(self._msg(n_topologies=0), b"")
+        with pytest.raises(ValueError):
+            make_aot_task(self._msg(n_topologies=65), b"")
+
+    def test_duplicate_topology_rejected(self):
+        from yadcc_tpu.daemon.local.aot_task import make_aot_task
+
+        msg = self._msg(n_topologies=1)
+        t = msg.topologies.add(device_count=1)
+        t.mesh_shape.append(1)
+        with pytest.raises(ValueError):
+            make_aot_task(msg, b"")
+
+    def test_inconsistent_topology_rejected(self):
+        from yadcc_tpu.daemon.local.aot_task import make_aot_task
+
+        msg = self._msg(n_topologies=0)
+        t = msg.topologies.add(device_count=3)  # != prod(mesh_shape)
+        t.mesh_shape.extend([2, 2])
+        with pytest.raises(ValueError):
+            make_aot_task(msg, b"")
+
+
+class TestMakeAutotuneTask:
+    def _msg(self, configs=None, width=0, **kw):
+        env = local_jit_environment("cpu")
+        msg = api.fanout.SubmitAutotuneTaskRequest(
+            requestor_process_id=1,
+            kernel_digest=kw.get("digest", digest_bytes(KERNEL)),
+            backend=kw.get("backend", "cpu"),
+            jaxlib_version=kw.get("jaxlib_version", env.jaxlib_version),
+            cache_control=1,
+            fanout_width=width)
+        msg.configs.extend(
+            configs if configs is not None
+            else ['{"block_m":64}', '{"block_m":128}'])
+        return msg
+
+    def test_missing_environment_raises(self):
+        from yadcc_tpu.daemon.local.autotune_task import \
+            make_autotune_task
+        from yadcc_tpu.daemon.local.jit_task import NeedJitEnvironment
+
+        with pytest.raises(NeedJitEnvironment):
+            make_autotune_task(self._msg(backend=""), b"")
+
+    def test_empty_space_and_bad_config_rejected(self):
+        from yadcc_tpu.daemon.local.autotune_task import \
+            make_autotune_task
+
+        with pytest.raises(ValueError):
+            make_autotune_task(self._msg(configs=[]), b"")
+        with pytest.raises(ValueError):
+            make_autotune_task(self._msg(configs=["not json"]), b"")
+        with pytest.raises(ValueError):
+            make_autotune_task(self._msg(configs=["[1,2]"]), b"")
+
+    def test_width_defaults_and_clamps(self):
+        from yadcc_tpu.daemon.local.autotune_task import \
+            make_autotune_task
+
+        task = make_autotune_task(self._msg(), b"")
+        assert task.fanout_width == 2  # clamped to config count
+        task = make_autotune_task(self._msg(width=100), b"")
+        assert task.fanout_width == 2
+
+
+# -- servant-side gating ------------------------------------------------------
+
+
+@pytest.fixture
+def standalone_service(tmp_path, monkeypatch):
+    monkeypatch.setenv("YTPU_JIT_FAKE_WORKER", "1")
+    from yadcc_tpu.daemon.cloud.compiler_registry import CompilerRegistry
+    from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
+    from yadcc_tpu.daemon.cloud.execution_engine import ExecutionEngine
+    from yadcc_tpu.daemon.config import DaemonConfig
+
+    engine = ExecutionEngine(max_concurrency=2,
+                             min_memory_for_new_task=1)
+    service = DaemonService(
+        DaemonConfig(temporary_dir=str(tmp_path)),
+        engine=engine,
+        registry=CompilerRegistry(extra_dirs=[str(tmp_path / "nobin")]),
+        cgroup_present=False,
+        jit_environments=[local_jit_environment("cpu")])
+    service.set_acceptable_tokens_for_testing({"tkn"})
+    yield service
+    engine.stop()
+
+
+class TestServantGating:
+    def _aot_req(self, env_digest, claimed=""):
+        req = api.fanout.QueueAotCompilationTaskRequest(
+            token="tkn", task_grant_id=7,
+            computation_digest=claimed or digest_bytes(HLO),
+            backend="cpu",
+            compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        req.env_desc.compiler_digest = env_digest
+        req.topology.mesh_shape.append(2)
+        req.topology.device_count = 2
+        return req
+
+    def test_aot_version_mismatch_rejected(self, standalone_service):
+        from yadcc_tpu.jit.env import jit_env_digest
+        from yadcc_tpu.rpc import RpcError
+
+        bad = jit_env_digest("cpu", "some-other-jaxlib")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueAotCompilationTask(
+                self._aot_req(bad), compress.compress(HLO), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE
+
+    def test_aot_forged_digest_rejected(self, standalone_service):
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueAotCompilationTask(
+                self._aot_req(env.digest, claimed="0" * 64),
+                compress.compress(HLO), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+    def test_aot_missing_topology_rejected(self, standalone_service):
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        req = self._aot_req(env.digest)
+        req.ClearField("topology")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueAotCompilationTask(
+                req, compress.compress(HLO), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+    def test_autotune_garbage_attachment_rejected(self,
+                                                  standalone_service):
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        req = api.fanout.QueueAutotuneTaskRequest(
+            token="tkn", task_grant_id=7,
+            kernel_digest=digest_bytes(KERNEL), backend="cpu",
+            compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        req.env_desc.compiler_digest = env.digest
+        req.configs.append('{"block_m":64}')
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueAutotuneTask(
+                req, b"not zstd at all", None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+    def test_autotune_bad_config_rejected(self, standalone_service):
+        from yadcc_tpu.rpc import RpcError
+
+        env = local_jit_environment("cpu")
+        req = api.fanout.QueueAutotuneTaskRequest(
+            token="tkn", task_grant_id=7,
+            kernel_digest=digest_bytes(KERNEL), backend="cpu",
+            compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        req.env_desc.compiler_digest = env.digest
+        req.configs.append("not json")
+        with pytest.raises(RpcError) as exc:
+            standalone_service.QueueAutotuneTask(
+                req, compress.compress(KERNEL), None)
+        assert exc.value.status == \
+            api.daemon.DAEMON_STATUS_INVALID_ARGUMENT
+
+
+# -- loopback-cluster e2e: the ISSUE 8 acceptance criteria --------------------
+
+
+@pytest.fixture(scope="module")
+def fanout_cluster(tmp_path_factory):
+    os.environ["YTPU_JIT_FAKE_WORKER"] = "1"
+    tmp = tmp_path_factory.mktemp("fanout_e2e")
+    compiler_dir = tmp / "bin"
+    make_fake_compiler(str(compiler_dir))
+    c = LocalCluster(tmp, n_servants=1, servant_concurrency=4,
+                     compiler_dirs=[str(compiler_dir)])
+    c.compiler_dir = str(compiler_dir)
+    yield c
+    c.stop()
+    os.environ.pop("YTPU_JIT_FAKE_WORKER", None)
+
+
+def _submit(delegate, task, timeout_s=90.0):
+    tid = delegate.queue_task(task)
+    result = delegate.wait_for_task(tid, timeout_s)
+    delegate.free_task(tid)
+    return result
+
+
+def _servant_runs(cluster) -> int:
+    return sum(s.engine.tasks_run_ever for s in cluster.servants)
+
+
+def _kind_stats(delegate, kind):
+    return delegate.inspect()["stats_by_kind"].get(
+        kind, {"hit_cache": 0, "reused": 0, "actually_run": 0,
+               "failed": 0, "shed_to_local": 0})
+
+
+class TestAotPartialHitE2E:
+    def test_partial_hit_then_full_hit(self, fanout_cluster):
+        """ISSUE 8 acceptance: N=4 topologies with 1 pre-cached ->
+        exactly 3 servant compiles; a second identical submission ->
+        0."""
+        c = fanout_cluster
+        hlo = b"module @aot_ph { func.func public @main() { return } }"
+        topos = [_topo(1), _topo(2), _topo(4), _topo(2, 2)]
+
+        # Pre-cache topology 0 via a single-topology submission.
+        r = _submit(c.delegate, make_aot_parent(hlo, topos[:1]))
+        assert r is not None and r.exit_code == 0
+        assert [v.status for v in r.verdicts] == ["ok"]
+
+        # Wait until the fill is visible through the Bloom replica: a
+        # resubmission of the same single topology reads pure cache.
+        for _ in range(40):
+            time.sleep(0.25)
+            c.cache_reader.sync_once()
+            r = _submit(c.delegate, make_aot_parent(hlo, topos[:1]))
+            if r.verdicts[0].status == "cached":
+                break
+        assert r.verdicts[0].status == "cached", \
+            "pre-cached topology never became visible"
+
+        runs0 = _servant_runs(c)
+        stats0 = _kind_stats(c.delegate, "aot")
+        r = _submit(c.delegate, make_aot_parent(hlo, topos))
+        assert r is not None and r.exit_code == 0
+        by_key = {v.child_key: v.status for v in r.verdicts}
+        assert by_key[topos[0].tag()] == "cached"
+        assert sorted(by_key.values()) == ["cached", "ok", "ok", "ok"]
+        # Exactly 3 servant compiles — at the engine AND the counters.
+        assert _servant_runs(c) == runs0 + 3
+        stats1 = _kind_stats(c.delegate, "aot")
+        assert stats1["actually_run"] - stats0["actually_run"] == 3
+        assert stats1["hit_cache"] - stats0["hit_cache"] >= 1
+        # All four artifacts present, topology-keyed.
+        assert sorted(r.files) == sorted(f".{t.tag()}.xla"
+                                         for t in topos)
+
+        # Second identical submission: 0 servant compiles.
+        runs1 = _servant_runs(c)
+        for _ in range(40):
+            time.sleep(0.25)
+            c.cache_reader.sync_once()
+            r2 = _submit(c.delegate, make_aot_parent(hlo, topos))
+            if all(v.status == "cached" for v in r2.verdicts):
+                break
+        assert all(v.status == "cached" for v in r2.verdicts), \
+            "second identical submission still fanned out"
+        assert _servant_runs(c) == runs1
+        # Artifacts byte-identical to the first pass.
+        for key in r.files:
+            assert bytes(r2.files[key]) == bytes(r.files[key])
+
+
+class TestAutotuneSweepE2E:
+    def test_winner_served_from_sweep_cache_to_second_delegate(
+            self, fanout_cluster):
+        """ISSUE 8 acceptance: a sweep's winning config is served from
+        the sweep-level cache to a second delegate — zero fan-out,
+        zero servant time."""
+        from yadcc_tpu.daemon.local.autotune_task import (
+            WINNER_RECORD_KEY,
+            parse_winner_record,
+        )
+
+        c = fanout_cluster
+        kernel = b"def sweep_kernel():  # {block_m} {block_n}\n"
+        configs = SearchSpace.of(block_m=[32, 64, 128],
+                                 block_n=[32, 64]).expand()
+        r1 = _submit(c.delegate, make_sweep_parent(kernel, configs,
+                                                   width=3))
+        assert r1 is not None and r1.exit_code == 0
+        winner1 = parse_winner_record(r1.files[WINNER_RECORD_KEY])
+        assert winner1 is not None
+        assert winner1["evaluated"] == len(configs)
+        assert json.loads(
+            fanout.canonical_config(winner1["config"])) in \
+            [json.loads(cfg) for cfg in configs]
+
+        runs0 = _servant_runs(c)
+        d2 = c.make_extra_delegate()
+        r2 = None
+        for _ in range(40):
+            time.sleep(0.25)
+            c.cache_reader.sync_once()
+            r2 = _submit(d2, make_sweep_parent(kernel, configs, width=3))
+            if r2 is not None and r2.from_cache:
+                break
+        assert r2 is not None and r2.from_cache, \
+            "sweep winner never served from the sweep-level cache"
+        assert r2.verdicts == []  # no fan-out happened at all
+        winner2 = parse_winner_record(r2.files[WINNER_RECORD_KEY])
+        assert winner2 == winner1
+        assert _servant_runs(c) == runs0
+        assert _kind_stats(d2, "autotune")["hit_cache"] >= 1
+
+    def test_winner_is_deterministic_best_of_space(self, fanout_cluster):
+        """The reduce must pick the globally best config — recompute
+        the fake worker's scoring here and compare."""
+        from yadcc_tpu.daemon.local.autotune_task import (
+            WINNER_RECORD_KEY,
+            parse_winner_record,
+        )
+        from yadcc_tpu.jit.compile_worker import _config_score_fake
+
+        c = fanout_cluster
+        kernel = b"def det_kernel():  # {block_m}\n"
+        configs = SearchSpace.of(block_m=[16, 32, 64, 128, 256]).expand()
+        r = _submit(c.delegate, make_sweep_parent(kernel, configs,
+                                                  width=4))
+        assert r is not None and r.exit_code == 0
+        winner = parse_winner_record(r.files[WINNER_RECORD_KEY])
+        expected = max(
+            (json.loads(cfg) for cfg in configs),
+            key=lambda cfg: _config_score_fake(cfg, kernel))
+        assert winner["config"] == expected
+
+
+class TestFourKindProvenance:
+    def test_aggregate_equals_sum_of_kinds_with_all_four_active(
+            self, fanout_cluster):
+        """Registry hardening satellite: all four kinds through ONE
+        dispatcher; the aggregate counters must stay exactly the sum
+        of the per-kind split."""
+        from yadcc_tpu.daemon.local.cxx_task import CxxCompilationTask
+        from yadcc_tpu.daemon.local.jit_task import JitCompilationTask
+
+        c = fanout_cluster
+        env = local_jit_environment("cpu")
+        src = b"int four_kinds();"
+        results = [
+            _submit(c.delegate, CxxCompilationTask(
+                requestor_pid=1, source_path="/src/fk.cc",
+                source_digest=digest_bytes(src),
+                invocation_arguments="-O2", cache_control=0,
+                compiler_digest=digest_file(
+                    c.compiler_dir + "/g++"),
+                compressed_source=compress.compress(src))),
+            _submit(c.delegate, JitCompilationTask(
+                requestor_pid=1,
+                computation_digest=digest_bytes(HLO),
+                compile_options=b"", backend="cpu",
+                jaxlib_version=env.jaxlib_version, cache_control=0,
+                compressed_computation=compress.compress(HLO))),
+            _submit(c.delegate, make_aot_parent(
+                b"module @fk_aot { func.func public @main() "
+                b"{ return } }", cache_control=0)),
+            _submit(c.delegate, make_sweep_parent(
+                b"def fk_kernel():  # {block_m}\n", cache_control=0)),
+        ]
+        for r in results:
+            assert r is not None and r.exit_code == 0
+        snapshot = c.delegate.inspect()
+        by_kind = snapshot["stats_by_kind"]
+        assert set(by_kind) >= {"cxx", "jit", "aot", "autotune"}
+        for kind in ("cxx", "jit", "aot", "autotune"):
+            assert by_kind[kind]["actually_run"] >= 1
+        agg = snapshot["stats"]
+        for counter in agg:
+            assert agg[counter] == sum(v[counter]
+                                       for v in by_kind.values()), \
+                f"aggregate {counter} != sum of per-kind"
+
+
+# -- the HTTP protocol --------------------------------------------------------
+
+
+class TestFanoutHttpRoutes:
+    def test_aot_submit_wait_roundtrip_with_verdicts(self,
+                                                     fanout_cluster):
+        env = local_jit_environment("cpu")
+        hlo = b"module @aot_http { func.func public @main() { return } }"
+        req = api.fanout.SubmitAotTaskRequest(
+            requestor_process_id=1,
+            computation_digest=digest_bytes(hlo),
+            backend="cpu", jaxlib_version=env.jaxlib_version,
+            cache_control=0)
+        for n in (1, 2):
+            t = req.topologies.add(device_count=n)
+            t.mesh_shape.append(n)
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(hlo)])
+        status, data = post_local(fanout_cluster.http.port,
+                                  "/local/submit_aot_task", body)
+        assert status == 200
+        task_id = int(json.loads(data)["task_id"])
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            wreq = api.fanout.WaitForAotTaskRequest(
+                task_id=task_id, milliseconds_to_wait=1000)
+            status, data = post_local(
+                fanout_cluster.http.port, "/local/wait_for_aot_task",
+                json_format.MessageToJson(wreq).encode())
+            if status != 503:
+                break
+        assert status == 200
+        chunks = multi_chunk.try_parse_multi_chunk(data)
+        msg = json_format.Parse(bytes(chunks[0]),
+                                api.fanout.WaitForAotTaskResponse())
+        assert msg.exit_code == 0
+        assert len(msg.verdicts) == 2
+        assert all(v.status == "ok" for v in msg.verdicts)
+        assert len(msg.artifact_keys) == 2
+        assert len(chunks) == 3
+        for chunk in chunks[1:]:
+            assert compress.decompress(
+                bytes(chunk)).startswith(b"FAKEXLA1")
+
+    def test_autotune_submit_wait_roundtrip(self, fanout_cluster):
+        env = local_jit_environment("cpu")
+        kernel = b"def http_kernel():  # {block_m}\n"
+        req = api.fanout.SubmitAutotuneTaskRequest(
+            requestor_process_id=1,
+            kernel_digest=digest_bytes(kernel),
+            backend="cpu", jaxlib_version=env.jaxlib_version,
+            cache_control=0, fanout_width=2)
+        req.configs.extend(SearchSpace.of(block_m=[64, 128, 256])
+                           .expand())
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(kernel)])
+        status, data = post_local(fanout_cluster.http.port,
+                                  "/local/submit_autotune_task", body)
+        assert status == 200
+        task_id = int(json.loads(data)["task_id"])
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            wreq = api.fanout.WaitForAutotuneTaskRequest(
+                task_id=task_id, milliseconds_to_wait=1000)
+            status, data = post_local(
+                fanout_cluster.http.port,
+                "/local/wait_for_autotune_task",
+                json_format.MessageToJson(wreq).encode())
+            if status != 503:
+                break
+        assert status == 200
+        chunks = multi_chunk.try_parse_multi_chunk(data)
+        msg = json_format.Parse(
+            bytes(chunks[0]), api.fanout.WaitForAutotuneTaskResponse())
+        assert msg.exit_code == 0
+        winner = json.loads(msg.winner_config_json)
+        assert "config" in winner and "score" in winner
+        assert len(msg.verdicts) == 2
+
+    def test_oversized_fanout_is_400(self, fanout_cluster):
+        env = local_jit_environment("cpu")
+        req = api.fanout.SubmitAotTaskRequest(
+            requestor_process_id=1,
+            computation_digest=digest_bytes(HLO),
+            backend="cpu", jaxlib_version=env.jaxlib_version,
+            cache_control=1)
+        for n in range(1, 66):  # 65 > MAX_FANOUT_WIDTH
+            t = req.topologies.add(device_count=n)
+            t.mesh_shape.append(n)
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(HLO)])
+        status, data = post_local(fanout_cluster.http.port,
+                                  "/local/submit_aot_task", body)
+        assert status == 400
+        assert b"invalid fan-out submission" in data
+
+    def test_missing_environment_is_400_then_retry(self, fanout_cluster):
+        env = local_jit_environment("cpu")
+        req = api.fanout.SubmitAutotuneTaskRequest(
+            requestor_process_id=1,
+            kernel_digest=digest_bytes(KERNEL),
+            backend="cpu", cache_control=1)  # jaxlib_version missing
+        req.configs.append('{"block_m":64}')
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(KERNEL)])
+        status, data = post_local(fanout_cluster.http.port,
+                                  "/local/submit_autotune_task", body)
+        assert status == 400
+        assert b"jit environment" in data
+        req.jaxlib_version = env.jaxlib_version
+        body = multi_chunk.make_multi_chunk([
+            json_format.MessageToJson(req).encode(),
+            compress.compress(KERNEL)])
+        status, _ = post_local(fanout_cluster.http.port,
+                               "/local/submit_autotune_task", body)
+        assert status == 200
+
+    def test_frontend_aot_roundtrip(self, fanout_cluster, monkeypatch):
+        monkeypatch.setenv("YTPU_DAEMON_PORT",
+                           str(fanout_cluster.http.port))
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "1")
+        from yadcc_tpu.jit.aot import submit_aot_build
+
+        hlo = b"module @aot_fe { func.func public @main() { return } }"
+        topos = [_topo(1), _topo(4)]
+        out = submit_aot_build(hlo, topos)
+        assert out.ok and out.exit_code == 0
+        assert len(out.verdicts) == 2
+        for topo in topos:
+            assert out.artifact_for(topo).startswith(b"FAKEXLA1")
+
+    def test_frontend_autotune_roundtrip(self, fanout_cluster,
+                                         monkeypatch):
+        monkeypatch.setenv("YTPU_DAEMON_PORT",
+                           str(fanout_cluster.http.port))
+        monkeypatch.setenv("YTPU_JIT_OFFLOAD", "1")
+        from yadcc_tpu.jit.autotune import sweep
+
+        out = sweep(b"def fe_kernel():  # {block_m}\n",
+                    SearchSpace.of(block_m=[64, 128]), fanout_width=2)
+        assert out.ok and out.exit_code == 0
+        assert out.winning_config in ({"block_m": 64},
+                                      {"block_m": 128})

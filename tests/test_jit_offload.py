@@ -156,9 +156,12 @@ class TestTaskRegistry:
         from yadcc_tpu.daemon.local.task_registry import default_registry
 
         reg = default_registry(FileDigestCache())
-        assert reg.kinds() == ["cxx", "jit"]
+        assert reg.kinds() == ["aot", "autotune", "cxx", "jit"]
         assert reg.for_submit("/local/submit_jit_task").kind == "jit"
         assert reg.for_wait("/local/wait_for_cxx_task").kind == "cxx"
+        assert reg.for_submit("/local/submit_aot_task").kind == "aot"
+        assert reg.for_wait("/local/wait_for_autotune_task").kind == \
+            "autotune"
         assert reg.for_submit("/local/unknown") is None
 
     def test_duplicate_routes_rejected(self):

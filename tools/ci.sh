@@ -91,6 +91,20 @@ if ! python -m yadcc_tpu.tools.cluster_sim --workload jit --smoke; then
   fail=1
 fi
 
+echo "== fan-out workload smokes (aot + autotune) =="
+# Workloads 3 & 4 (doc/workloads.md): one submission fans out into
+# per-topology compiles / per-slice sweeps.  Each gate fails on any
+# task failure, any lost/hung task, or if fan-out dedup (child-level
+# cache+join, sweep-level winner reuse) never engaged.
+if ! python -m yadcc_tpu.tools.cluster_sim --workload aot --smoke; then
+  echo "aot fan-out smoke FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --workload autotune --smoke; then
+  echo "autotune fan-out smoke FAILED" >&2
+  fail=1
+fi
+
 echo "== chaos smoke (hostile-world scenario gates) =="
 # Robustness gates (doc/robustness.md): a flaky servant must not cost
 # a single task (survival via retries + local fallback), and the

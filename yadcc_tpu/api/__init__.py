@@ -10,6 +10,7 @@ from .gen import cache_pb2 as cache
 from .gen import daemon_pb2 as daemon
 from .gen import env_desc_pb2 as env_desc
 from .gen import extra_info_pb2 as extra_info
+from .gen import fanout_pb2 as fanout
 from .gen import jit_pb2 as jit
 from .gen import local_pb2 as local
 from .gen import patch_pb2 as patch
@@ -22,6 +23,7 @@ __all__ = [
     "daemon",
     "env_desc",
     "extra_info",
+    "fanout",
     "jit",
     "local",
     "patch",

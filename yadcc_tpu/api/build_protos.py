@@ -35,6 +35,7 @@ PROTOS = [
     "cache.proto",
     "local.proto",
     "jit.proto",
+    "fanout.proto",
 ]
 
 
@@ -239,8 +240,86 @@ def _scheduler_descriptor():
     return fd
 
 
+def _fanout_descriptor():
+    """fanout.proto (workloads 3 & 4: AOT multi-topology builds and
+    autotune sweeps) as a FileDescriptorProto.  MUST stay
+    field-for-field identical to protos/fanout.proto (the
+    human-readable source of truth; lint's wire-drift rule checks)."""
+    from google.protobuf import descriptor_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="fanout.proto", package="ytpu.api", syntax="proto3",
+        dependency=["env_desc.proto"])
+    _msg(fd, "AotTopologySpec",
+         ("mesh_shape", 1, "uint32", "repeated"),
+         ("device_count", 2, "uint32"),
+         ("compile_options", 3, "bytes"))
+    _msg(fd, "SubmitAotTaskRequest",
+         ("requestor_process_id", 1, "int32"),
+         ("computation_digest", 2, "string"),
+         ("backend", 3, "string"),
+         ("jaxlib_version", 4, "string"),
+         ("cache_control", 5, "int32"),
+         ("topologies", 6, ".ytpu.api.AotTopologySpec", "repeated"))
+    _msg(fd, "WaitForAotTaskRequest",
+         ("task_id", 1, "uint64"),
+         ("milliseconds_to_wait", 2, "uint32"))
+    _msg(fd, "FanoutChildVerdict",
+         ("child_key", 1, "string"),
+         ("status", 2, "string"),
+         ("exit_code", 3, "int32"),
+         ("attempts", 4, "uint32"),
+         ("error", 5, "string"))
+    _msg(fd, "WaitForAotTaskResponse",
+         ("exit_code", 1, "int32"),
+         ("output", 2, "string"),
+         ("error", 3, "string"),
+         ("artifact_keys", 4, "string", "repeated"),
+         ("verdicts", 5, ".ytpu.api.FanoutChildVerdict", "repeated"))
+    _msg(fd, "SubmitAutotuneTaskRequest",
+         ("requestor_process_id", 1, "int32"),
+         ("kernel_digest", 2, "string"),
+         ("backend", 3, "string"),
+         ("jaxlib_version", 4, "string"),
+         ("cache_control", 5, "int32"),
+         ("configs", 6, "string", "repeated"),
+         ("fanout_width", 7, "uint32"))
+    _msg(fd, "WaitForAutotuneTaskRequest",
+         ("task_id", 1, "uint64"),
+         ("milliseconds_to_wait", 2, "uint32"))
+    _msg(fd, "WaitForAutotuneTaskResponse",
+         ("exit_code", 1, "int32"),
+         ("output", 2, "string"),
+         ("error", 3, "string"),
+         ("winner_config_json", 4, "string"),
+         ("artifact_keys", 5, "string", "repeated"),
+         ("verdicts", 6, ".ytpu.api.FanoutChildVerdict", "repeated"))
+    _msg(fd, "QueueAotCompilationTaskRequest",
+         ("token", 1, "string"),
+         ("task_grant_id", 2, "uint64"),
+         ("env_desc", 3, ".ytpu.api.EnvironmentDesc"),
+         ("computation_digest", 4, "string"),
+         ("backend", 5, "string"),
+         ("compression_algorithm", 6, "uint32"),
+         ("disallow_cache_fill", 7, "bool"),
+         ("topology", 8, ".ytpu.api.AotTopologySpec"))
+    _msg(fd, "QueueAotCompilationTaskResponse", ("task_id", 1, "uint64"))
+    _msg(fd, "QueueAutotuneTaskRequest",
+         ("token", 1, "string"),
+         ("task_grant_id", 2, "uint64"),
+         ("env_desc", 3, ".ytpu.api.EnvironmentDesc"),
+         ("kernel_digest", 4, "string"),
+         ("backend", 5, "string"),
+         ("compression_algorithm", 6, "uint32"),
+         ("disallow_cache_fill", 7, "bool"),
+         ("configs", 8, "string", "repeated"))
+    _msg(fd, "QueueAutotuneTaskResponse", ("task_id", 1, "uint64"))
+    return fd
+
+
 PURE_BUILDERS = {"jit.proto": _jit_descriptor,
-                 "scheduler.proto": _scheduler_descriptor}
+                 "scheduler.proto": _scheduler_descriptor,
+                 "fanout.proto": _fanout_descriptor}
 
 _PURE_TEMPLATE = '''\
 # -*- coding: utf-8 -*-

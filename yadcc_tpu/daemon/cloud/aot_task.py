@@ -1,21 +1,11 @@
-"""Servant-side XLA jit-compilation task (ExecutionTask analogue).
+"""Servant-side AOT topology compile (one fan-out child).
 
-The jit twin of CloudCxxCompilationTask: prepare decompresses and
-digests the attached StableHLO (fused single pass, same as the C++
-source intake), verifies the client's claimed computation digest (a
-corrupted or forged attachment must fail fast, not poison the cache
-under the claimed key), and stages a request file for the compile
-worker; completion reads the worker's artifact, compresses it, and
-packs a kind="jit" cache entry through the shared zero-copy payload
-path.
-
-The compile itself is ``python -m yadcc_tpu.jit.compile_worker`` in its
-own process group via the SAME execution engine that runs compilers —
-admission control, reference counting, kill-on-lease-expiry and
-completed-task GC all come for free.  No path patching: serialized
-executables don't embed the workspace path, so the padded-workspace
-machinery is unnecessary here (the workspace exists only as the
-request/artifact staging area and dies with the task).
+The jit task's multi-topology twin: identical intake discipline (fused
+decompress⊕digest, claimed-digest verification, staged request file),
+with the topology spec carried into the compile worker's options — so
+the worker builds the executable for exactly the mesh the child was
+fanned out for — and into the cache identity (kind="aot" entries in the
+``ytpu-aot1-`` namespace, keyed per topology).
 """
 
 from __future__ import annotations
@@ -25,65 +15,31 @@ import os
 import shlex
 import sys
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ...common import compress
 from ...common.multi_chunk import make_multi_chunk
 from ...common.payload import Payload
 from .. import cache_format
-from ..cache_format import CacheEntry, get_jit_cache_key
-from ..task_digest import get_jit_task_digest
+from ..cache_format import CacheEntry, get_aot_cache_key
+from ..task_digest import get_aot_task_digest
 from .cxx_task import _PACK_EXECUTOR
 from .execution_engine import TaskOutput
+from .jit_task import _fake_worker, _worker_mem_bytes, \
+    worker_subprocess_env
 from .temporary import TemporaryDir
 
-# The one artifact key a jit task produces (the serialized executable);
-# a future multi-artifact compile (e.g. dumped HLO for diagnostics)
-# adds keys without a format change.
+# Same artifact key as the jit workload: a topology child produces one
+# serialized executable.
 ARTIFACT_KEY = ".xla"
-
-# Default address-space ceiling for the compile worker.  XLA on big
-# modules can balloon; a runaway compile must die inside its own
-# process, not take the servant down.  Override (or disable with 0) via
-# YTPU_JIT_WORKER_MEM_BYTES on the servant.
-_DEFAULT_WORKER_MEM_BYTES = 8 << 30
-
-
-def _worker_mem_bytes() -> int:
-    try:
-        return int(os.environ.get("YTPU_JIT_WORKER_MEM_BYTES",
-                                  _DEFAULT_WORKER_MEM_BYTES))
-    except ValueError:
-        return _DEFAULT_WORKER_MEM_BYTES
-
-
-def _fake_worker() -> bool:
-    """YTPU_JIT_FAKE_WORKER=1: deterministic pseudo-compiles (cluster
-    simulator / CI smoke — exercise the farm, not XLA)."""
-    return os.environ.get("YTPU_JIT_FAKE_WORKER", "0") == "1"
-
-
-def worker_subprocess_env() -> dict:
-    """Environment for a compile-worker subprocess: the daemon's own,
-    plus the package root on PYTHONPATH (the engine launches via
-    ``sh -c`` from the workspace, where bare ``-m yadcc_tpu...`` would
-    not resolve).  Shared by every worker-launching task kind (jit,
-    aot, autotune)."""
-    # __file__ is <root>/yadcc_tpu/daemon/cloud/jit_task.py; the
-    # importable root is <root>, the PARENT of the package dir.
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))))
-    env = dict(os.environ)
-    existing = env.get("PYTHONPATH", "")
-    env["PYTHONPATH"] = pkg_root + (os.pathsep + existing
-                                    if existing else "")
-    return env
 
 
 @dataclass
-class CloudJitCompilationTask:
+class CloudAotCompilationTask:
     env_digest: str
     backend: str
+    mesh_shape: Tuple[int, ...]
+    device_count: int
     compile_options: bytes
     claimed_computation_digest: str
     temp_root: str
@@ -105,11 +61,13 @@ class CloudJitCompilationTask:
                 self.computation_digest != self.claimed_computation_digest:
             raise ValueError("computation digest mismatch")
 
-        self.workspace = TemporaryDir(self.temp_root, "jit_")
+        self.workspace = TemporaryDir(self.temp_root, "aot_")
         options = {
             "backend": self.backend,
             "compile_options_hex": bytes(self.compile_options).hex(),
             "mem_limit_bytes": _worker_mem_bytes(),
+            "mesh_shape": list(self.mesh_shape),
+            "device_count": self.device_count,
         }
         with open(f"{self.workspace.path}/request.bin", "wb") as fp:
             fp.write(make_multi_chunk(
@@ -126,13 +84,22 @@ class CloudJitCompilationTask:
         return worker_subprocess_env()
 
     @property
+    def topology_digest(self) -> str:
+        from ...jit.fanout import TopologySpec
+
+        return TopologySpec(mesh_shape=tuple(self.mesh_shape),
+                            device_count=self.device_count,
+                            compile_options=bytes(
+                                self.compile_options)).digest()
+
+    @property
     def task_digest(self) -> str:
-        return get_jit_task_digest(self.env_digest, self.compile_options,
+        return get_aot_task_digest(self.env_digest, self.topology_digest,
                                    self.computation_digest)
 
     @property
     def cache_key(self) -> str:
-        return get_jit_cache_key(self.env_digest, self.compile_options,
+        return get_aot_cache_key(self.env_digest, self.topology_digest,
                                  self.computation_digest)
 
     # -- completion ----------------------------------------------------------
@@ -142,11 +109,9 @@ class CloudJitCompilationTask:
         Dict[str, list],
         Optional[Payload],
     ]:
-        """(compressed artifacts by key, empty patches, cache-entry
-        payload or None).  Cleans up the workspace — including the
-        killed-mid-compile case, where the engine's waiter still fires
-        this callback with the SIGKILL exit code and the workspace must
-        not leak."""
+        """Same contract as the jit task: (compressed artifacts, empty
+        patches, cache-entry payload or None), workspace removed on
+        every path including kill-mid-compile."""
         assert self.workspace is not None
         try:
             files: Dict[str, bytes] = {}
@@ -168,7 +133,7 @@ class CloudJitCompilationTask:
                             standard_output=output.standard_output,
                             standard_error=output.standard_error,
                             files=files,
-                            kind=cache_format.KIND_JIT,
+                            kind=cache_format.KIND_AOT,
                         ))
             return files, {}, (entry_future.result()
                                if entry_future is not None else None)

@@ -1,0 +1,147 @@
+"""Servant-side autotune slice sweep (one fan-out child).
+
+Evaluates a contiguous slice of a sweep's candidate configs against
+the attached kernel and writes the slice's WINNING CONFIG RECORD —
+JSON ``{"config": ..., "score": ..., "metric": ..., "evaluated": N}``
+— as its one artifact.  The record (not an executable) is what enters
+the cache (kind="autotune", ``ytpu-tune1-`` namespace, keyed by
+(env, slice digest, kernel digest)), so a second host sweeping the
+same slice of the same kernel gets the measurement for free.
+
+Intake discipline is the jit task's verbatim: fused decompress⊕digest,
+claimed-digest verification, bounded staged configs, workspace removed
+on every exit path.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ...common import compress
+from ...common.multi_chunk import make_multi_chunk
+from ...common.payload import Payload
+from ...jit.fanout import slice_digest
+from .. import cache_format
+from ..cache_format import CacheEntry, get_autotune_cache_key
+from ..task_digest import get_autotune_task_digest
+from .cxx_task import _PACK_EXECUTOR
+from .execution_engine import TaskOutput
+from .jit_task import _fake_worker, _worker_mem_bytes, \
+    worker_subprocess_env
+from .temporary import TemporaryDir
+
+# The slice child's one artifact: its winner record.
+RECORD_KEY = ".cfg"
+
+
+@dataclass
+class CloudAutotuneTask:
+    env_digest: str
+    backend: str
+    configs: List[str]  # canonical-JSON candidates (this slice)
+    claimed_kernel_digest: str
+    temp_root: str
+    disallow_cache_fill: bool = False
+
+    kernel_digest: str = ""
+    workspace: Optional[TemporaryDir] = None
+    cmdline: str = ""
+
+    # -- prepare -------------------------------------------------------------
+
+    def prepare(self, compressed_kernel: bytes) -> None:  # ytpu: acquires(workspace)
+        try:
+            kernel, self.kernel_digest = \
+                compress.decompress_and_digest(compressed_kernel)
+        except (compress.CompressionError, MemoryError, ValueError):
+            raise ValueError("kernel attachment is not valid zstd")
+        if self.claimed_kernel_digest and \
+                self.kernel_digest != self.claimed_kernel_digest:
+            raise ValueError("kernel digest mismatch")
+        parsed = []
+        for c in self.configs:
+            try:
+                obj = json.loads(c)
+            except ValueError:
+                obj = None
+            if not isinstance(obj, dict):
+                raise ValueError("config is not a JSON object")
+            parsed.append(obj)
+        if not parsed:
+            raise ValueError("empty config slice")
+
+        self.workspace = TemporaryDir(self.temp_root, "tune_")
+        options = {
+            "backend": self.backend,
+            "mem_limit_bytes": _worker_mem_bytes(),
+            "autotune_configs": parsed,
+        }
+        with open(f"{self.workspace.path}/request.bin", "wb") as fp:
+            fp.write(make_multi_chunk(
+                [json.dumps(options, sort_keys=True).encode(),
+                 kernel]))
+        fake = " --fake" if _fake_worker() else ""
+        self.cmdline = (
+            f"{shlex.quote(sys.executable)} -m "
+            f"yadcc_tpu.jit.compile_worker "
+            f"--workspace {shlex.quote(self.workspace.path)}{fake}"
+        )
+
+    def worker_env(self) -> dict:
+        return worker_subprocess_env()
+
+    @property
+    def slice_digest(self) -> str:
+        return slice_digest(self.configs)
+
+    @property
+    def task_digest(self) -> str:
+        return get_autotune_task_digest(self.env_digest,
+                                        self.slice_digest,
+                                        self.kernel_digest)
+
+    @property
+    def cache_key(self) -> str:
+        return get_autotune_cache_key(self.env_digest, self.slice_digest,
+                                      self.kernel_digest)
+
+    # -- completion ----------------------------------------------------------
+
+    def collect_outputs(self, output: TaskOutput) -> Tuple[
+        Dict[str, bytes],
+        Dict[str, list],
+        Optional[Payload],
+    ]:
+        """(compressed record by key, empty patches, cache-entry
+        payload or None); workspace removed on every path."""
+        assert self.workspace is not None
+        try:
+            files: Dict[str, bytes] = {}
+            record = None
+            if output.exit_code == 0:
+                try:
+                    with open(f"{self.workspace.path}/artifact.bin",
+                              "rb") as fp:
+                        record = fp.read()
+                except OSError:
+                    record = None
+            entry_future = None
+            if record is not None:
+                files[RECORD_KEY] = compress.compress(record)
+                if not self.disallow_cache_fill:
+                    entry_future = _PACK_EXECUTOR.get().submit(
+                        cache_format.write_cache_entry_payload, CacheEntry(
+                            exit_code=output.exit_code,
+                            standard_output=output.standard_output,
+                            standard_error=output.standard_error,
+                            files=files,
+                            kind=cache_format.KIND_AUTOTUNE,
+                        ))
+            return files, {}, (entry_future.result()
+                               if entry_future is not None else None)
+        finally:
+            self.workspace.remove()

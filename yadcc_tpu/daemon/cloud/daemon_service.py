@@ -28,6 +28,8 @@ from ..sysinfo import (
     read_memory_available,
     read_memory_total,
 )
+from .aot_task import CloudAotCompilationTask
+from .autotune_task import CloudAutotuneTask
 from .compiler_registry import CompilerRegistry
 from .cxx_task import CloudCxxCompilationTask
 from .distributed_cache_writer import DistributedCacheWriter
@@ -102,6 +104,12 @@ class DaemonService:
         s.add("QueueJitCompilationTask",
               api.jit.QueueJitCompilationTaskRequest,
               self.QueueJitCompilationTask)
+        s.add("QueueAotCompilationTask",
+              api.fanout.QueueAotCompilationTaskRequest,
+              self.QueueAotCompilationTask)
+        s.add("QueueAutotuneTask",
+              api.fanout.QueueAutotuneTaskRequest,
+              self.QueueAutotuneTask)
         s.add("ReferenceTask", api.daemon.ReferenceTaskRequest,
               self.ReferenceTask)
         s.add("WaitForCompilationOutput",
@@ -211,34 +219,31 @@ class DaemonService:
             raise
         return api.daemon.QueueCxxCompilationTaskResponse(task_id=task_id)
 
-    def QueueJitCompilationTask(self, req, attachment: bytes,
-                                ctx: RpcContext):  # ytpu: untrusted(req, attachment)
-        """Second-workload twin of QueueCxxCompilationTask: an XLA jit
-        compile lands on the same engine (admission, refcounts,
-        kill-on-lease-expiry) through the same generic wait/free RPC
-        surface; only submission is jit-specific."""
-        self._verify(req.token)
+    def _require_jit_env(self, req):
+        """Shared intake gate for the worker-subprocess task kinds
+        (jit/aot/autotune): zstd attachment + an advertised jit
+        environment.  Version gating: grants should only land here for
+        digests we advertised, but a direct (or stale-grant)
+        submission for an XLA stack we don't serve must be refused,
+        not compiled into an artifact the requestor cannot
+        deserialize."""
         if req.compression_algorithm != \
                 api.daemon.COMPRESSION_ALGORITHM_ZSTD:
             raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
-                           "only zstd computations accepted")
-        # Version gating: grants should only land here for digests we
-        # advertised, but a direct (or stale-grant) submission for an
-        # XLA stack we don't serve must be refused, not compiled into
-        # an artifact the requestor cannot deserialize.
+                           "only zstd attachments accepted")
         env = self._jit_env_digests.get(req.env_desc.compiler_digest)
         if env is None:
             raise RpcError(
                 api.daemon.DAEMON_STATUS_ENVIRONMENT_NOT_AVAILABLE,
                 req.env_desc.compiler_digest)
-        task = CloudJitCompilationTask(
-            env_digest=env.digest,
-            backend=req.backend or env.backend,
-            compile_options=req.compile_options,
-            claimed_computation_digest=req.computation_digest,
-            temp_root=self.config.temporary_dir,
-            disallow_cache_fill=req.disallow_cache_fill,
-        )
+        return env
+
+    def _queue_worker_task(self, task, grant_id: int, attachment):
+        """Prepare + queue one worker-subprocess task (jit/aot/
+        autotune) on the engine; returns the servant task id.  One
+        body for the three kinds: defensive dedup, completion capture,
+        cache fill, and the no-leak cleanup contract are identical —
+        only the task object differs."""
         try:
             try:
                 task.prepare(attachment)
@@ -248,15 +253,14 @@ class DaemonService:
 
             # Defensive dedup, same as cxx: the delegate-side join
             # usually catches duplicate compilations first, but N
-            # delegates racing the same cold model step can all be
-            # granted before any of them shows up in the running-task
+            # delegates racing the same cold task can all be granted
+            # before any of them shows up in the running-task
             # snapshot.
             existing = self.engine.find_task_by_digest(task.task_digest)
             if existing is not None and \
                     self.engine.reference_task(existing):
                 task.workspace.remove()
-                return api.jit.QueueJitCompilationTaskResponse(
-                    task_id=existing)
+                return existing
 
             def on_completion(task_id: int, output):
                 files, patches, cache_entry = task.collect_outputs(output)
@@ -275,14 +279,13 @@ class DaemonService:
                                                   cache_entry)
 
             task_id = self.engine.try_queue_task(
-                grant_id=req.task_grant_id,
+                grant_id=grant_id,
                 digest=task.task_digest,
                 cmdline=task.cmdline,
                 on_completion=on_completion,
                 # The worker needs the package importable from the
-                # engine's `sh -c` launch; serialized executables embed
-                # no paths, so no padded workspace (see
-                # cloud/jit_task.py).
+                # engine's `sh -c` launch; worker artifacts embed no
+                # paths, so no padded workspace (see cloud/jit_task.py).
                 env=task.worker_env(),
                 cwd=task.workspace.path,
             )
@@ -295,7 +298,72 @@ class DaemonService:
             if task.workspace is not None:
                 task.workspace.remove()
             raise
+        return task_id
+
+    def QueueJitCompilationTask(self, req, attachment: bytes,
+                                ctx: RpcContext):  # ytpu: untrusted(req, attachment)
+        """Second-workload twin of QueueCxxCompilationTask: an XLA jit
+        compile lands on the same engine (admission, refcounts,
+        kill-on-lease-expiry) through the same generic wait/free RPC
+        surface; only submission is jit-specific."""
+        self._verify(req.token)
+        env = self._require_jit_env(req)
+        task = CloudJitCompilationTask(
+            env_digest=env.digest,
+            backend=req.backend or env.backend,
+            compile_options=req.compile_options,
+            claimed_computation_digest=req.computation_digest,
+            temp_root=self.config.temporary_dir,
+            disallow_cache_fill=req.disallow_cache_fill,
+        )
+        task_id = self._queue_worker_task(task, req.task_grant_id,
+                                          attachment)
         return api.jit.QueueJitCompilationTaskResponse(task_id=task_id)
+
+    def QueueAotCompilationTask(self, req, attachment: bytes,
+                                ctx: RpcContext):  # ytpu: untrusted(req, attachment)
+        """One AOT fan-out CHILD: the jit flow with the topology folded
+        into the worker options and the cache identity
+        (doc/workloads.md)."""
+        self._verify(req.token)
+        env = self._require_jit_env(req)
+        if req.topology.device_count <= 0 or \
+                not req.topology.mesh_shape:
+            raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
+                           "aot submission names no topology")
+        task = CloudAotCompilationTask(
+            env_digest=env.digest,
+            backend=req.backend or env.backend,
+            mesh_shape=tuple(req.topology.mesh_shape),
+            device_count=req.topology.device_count,
+            compile_options=req.topology.compile_options,
+            claimed_computation_digest=req.computation_digest,
+            temp_root=self.config.temporary_dir,
+            disallow_cache_fill=req.disallow_cache_fill,
+        )
+        task_id = self._queue_worker_task(task, req.task_grant_id,
+                                          attachment)
+        return api.fanout.QueueAotCompilationTaskResponse(
+            task_id=task_id)
+
+    def QueueAutotuneTask(self, req, attachment: bytes,
+                          ctx: RpcContext):  # ytpu: untrusted(req, attachment)
+        """One autotune fan-out CHILD: evaluate a config slice; the
+        artifact is the slice's winning-config record
+        (doc/workloads.md)."""
+        self._verify(req.token)
+        env = self._require_jit_env(req)
+        task = CloudAutotuneTask(
+            env_digest=env.digest,
+            backend=req.backend or env.backend,
+            configs=list(req.configs),
+            claimed_kernel_digest=req.kernel_digest,
+            temp_root=self.config.temporary_dir,
+            disallow_cache_fill=req.disallow_cache_fill,
+        )
+        task_id = self._queue_worker_task(task, req.task_grant_id,
+                                          attachment)
+        return api.fanout.QueueAutotuneTaskResponse(task_id=task_id)
 
     def ReferenceTask(self, req, attachment, ctx):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)

@@ -209,6 +209,10 @@ def daemon_start(args) -> None:
         cache_reader=cache_reader,
         running_task_keeper=running_keeper,
         debugging_always_use_servant_at=config.debugging_always_use_servant_at,
+        # Fan-out parents fill their reduced verdict (the autotune
+        # sweep-level winner record) through the servant role's writer
+        # — static token, same as compile-output fills.
+        cache_writer=cache_writer,
     )
     monitor = LocalTaskMonitor(
         max_heavy_tasks=config.max_local_tasks,

@@ -26,6 +26,10 @@ class TaskResult:
     # Provenance counters (reference distributed_task_dispatcher.h:222-224).
     from_cache: bool = False
     reused_existing: bool = False
+    # Fan-out parents only (jit/fanout.py): one ChildVerdict per child,
+    # in submission order — the partial-hit / partial-failure contract
+    # surfaces these to the client verbatim (doc/workloads.md).
+    verdicts: List = field(default_factory=list)
 
 
 class DistributedTask:
@@ -38,6 +42,12 @@ class DistributedTask:
     (stable, lowercase) used for per-workload stats and diagnostics."""
 
     kind = "unknown"
+
+    # Fan-out parents (jit/fanout.py) set this True and implement
+    # expand_children()/reduce() instead of the servant-facing methods;
+    # the dispatcher routes them through its fan-out path, where every
+    # child is a normal DistributedTask of the same kind.
+    is_fanout = False
 
     # Weighted-fair grant admission (doc/robustness.md): grants are
     # handed out fair-share across fairness keys, weighted by this.  A

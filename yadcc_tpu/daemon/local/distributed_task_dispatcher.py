@@ -29,6 +29,7 @@ from enum import Enum
 from typing import Dict, Optional
 
 from ... import api
+from ...jit import fanout
 from ...rpc import Channel, RpcError
 from ...utils.logging import get_logger
 from .config_keeper import ConfigKeeper
@@ -77,11 +78,18 @@ class DistributedTaskDispatcher:
         running_task_keeper: Optional[RunningTaskKeeper] = None,
         pid_prober=None,
         debugging_always_use_servant_at: str = "",
+        cache_writer=None,
     ):
         self._grants = grant_keeper
         self._config = config_keeper
         self._cache = cache_reader
         self._running = running_task_keeper
+        # Delegate-side cache fills — used ONLY by fan-out parents
+        # whose reduced verdict is itself cacheable (the autotune
+        # sweep-level winner record); per-child artifacts still fill
+        # servant-side like every other workload.  None = no parent
+        # fills (the parent result is still correct, just not shared).
+        self._cache_writer = cache_writer
         self._pid_alive = pid_prober or _default_pid_alive
         # Debug override (reference --debugging_always_use_servant_at):
         # every servant dial goes HERE; grants still flow normally.
@@ -150,6 +158,8 @@ class DistributedTaskDispatcher:
     def _perform_one_task(self, entry: _Entry) -> None:
         try:
             result = self._try_read_cache(entry)
+            if result is None and entry.task.is_fanout:
+                result = self._perform_fanout(entry)
             if result is None:
                 result = self._try_join_existing(entry)
             if result is None:
@@ -193,6 +203,42 @@ class DistributedTaskDispatcher:
             self._bump_locked(entry.task.kind, "hit_cache")
         return result
 
+    def _perform_fanout(self, entry: _Entry) -> TaskResult:
+        """Fan-out parents (jit/fanout.py): expand into child tasks —
+        each a normal DistributedTask re-entering this dispatcher's
+        cache→join→dispatch machinery with its own cache key, digest
+        and grant — then join them with bounded retries and reduce to
+        one result with explicit per-child verdicts.  The parent
+        itself never talks to a servant, so it holds no grant and
+        consumes no engine slot; only its children do.  Provenance:
+        children bump the per-kind counters through the normal path
+        (that is what makes partial hits provable via
+        ``actually_run``); the parent bumps nothing on success."""
+        children = entry.task.expand_children()
+        outcomes = fanout.run_fanout(
+            children,
+            queue=self.queue_task,
+            wait=self.wait_for_task,
+            free=self.free_task,
+            aborted=lambda: entry.aborted,
+        )
+        result = entry.task.reduce(outcomes)
+        self._maybe_fill_parent_cache(entry.task, result)
+        return result
+
+    def _maybe_fill_parent_cache(self, task: DistributedTask,
+                                 result: TaskResult) -> None:
+        if self._cache_writer is None:
+            return
+        make = getattr(task, "make_parent_cache_entry", None)
+        if make is None:
+            return
+        filled = make(result)
+        if filled is None:
+            return
+        key, payload = filled
+        self._cache_writer.async_write(key, payload)
+
     def _try_join_existing(self, entry: _Entry) -> Optional[TaskResult]:
         """Duplicate-compilation joining (reference :256-300): if some
         servant is already compiling this digest, reference it and wait
@@ -220,6 +266,9 @@ class DistributedTaskDispatcher:
         # never reaches zero and it leaks until servant GC.
         self._free_servant_task(entry, token)
         if result is not None:
+            # Mark the provenance on the result too (not just the
+            # counter): fan-out verdicts report "joined" from it.
+            result.reused_existing = True
             with self._lock:
                 self._bump_locked(entry.task.kind, "reused")
         return result

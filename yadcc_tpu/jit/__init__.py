@@ -22,11 +22,21 @@ Layers (doc/jit_offload.md):
 * ``cache_shim.py``— JAX persistent-compilation-cache-style get/put
                      over the cluster cache, for programs that want
                      cache *sharing* without compile *offload*.
-* ``compile_worker.py`` — the servant's sandboxed compile subprocess.
+* ``compile_worker.py`` — the servant's sandboxed compile subprocess
+                     (also runs AOT topology builds and autotune
+                     sweeps).
+* ``fanout.py``    — the fan-out machinery for workloads 3 & 4: one
+                     logical submission expanded into many
+                     independently cached/deduped child tasks (bounded
+                     width, fairness splitting, retry/straggler
+                     semantics, per-child verdicts; doc/workloads.md).
+* ``aot.py``       — client side of AOT multi-topology builds.
+* ``autotune.py``  — client side of Pallas/autotune sweeps
+                     (``SearchSpace`` → winning-config record).
 
 Delegate/servant task implementations live with their peers in
-``yadcc_tpu/daemon/local/jit_task.py`` / ``yadcc_tpu/daemon/cloud/
-jit_task.py``.
+``yadcc_tpu/daemon/local/{jit,aot,autotune}_task.py`` /
+``yadcc_tpu/daemon/cloud/{jit,aot,autotune}_task.py``.
 """
 
 from .env import (

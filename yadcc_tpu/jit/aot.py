@@ -1,0 +1,148 @@
+"""Client side of the AOT multi-topology build (workload 3).
+
+One call submits a lowered computation plus a list of target
+topologies; the delegate fans the submission out into per-topology
+child compiles (partial-hit: already-cached topologies never
+recompile) and the joined reply carries one artifact per topology and
+an explicit per-child verdict.  Like jit/frontend.py this module is
+pure bytes — it never imports jax — and every knob is the same
+YTPU_JIT_* env-var family (client/env_options.py).
+
+    POST /local/submit_aot_task    multi-chunk [json, zstd StableHLO]
+    POST /local/wait_for_aot_task  503 running / 404 unknown /
+                                   200 multi-chunk [json, artifacts...]
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from google.protobuf import json_format
+
+from .. import api
+from ..client import env_options
+from ..client.daemon_call import call_daemon
+from ..common import compress, multi_chunk
+from ..common.hashing import digest_bytes
+from .env import local_jit_environment
+from .fanout import TopologySpec
+from .frontend import longpoll_task
+
+
+@dataclass
+class AotOutcome:
+    """The joined fan-out result for one submission.  ``ok`` is the
+    infrastructure verdict (False: daemon unreachable / submit
+    refused / timed out — nothing ran); with ``ok`` True, consult
+    ``verdicts`` per topology: a partial failure surfaces there, with
+    the successful topologies' artifacts still present."""
+
+    ok: bool
+    exit_code: int = -1
+    error: str = ""
+    # topology child key (".{tag}.xla" artifact key) -> raw bytes.
+    artifacts: Dict[str, bytes] = field(default_factory=dict)
+    # Per-child dicts: child_key / status / exit_code / attempts / error.
+    verdicts: List[dict] = field(default_factory=list)
+
+    def artifact_for(self, topology: TopologySpec) -> Optional[bytes]:
+        return self.artifacts.get(f".{topology.tag()}.xla")
+
+
+def submit_aot_build(
+    computation: bytes,
+    topologies: Sequence[TopologySpec],
+    *,
+    backend: str = "cpu",
+    jaxlib_version: Optional[str] = None,
+    cache_control: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+) -> AotOutcome:
+    """Submit one StableHLO module for AOT compilation across
+    ``topologies``; blocks until the joined verdict (or timeout)."""
+    if not env_options.jit_offload_enabled():
+        return AotOutcome(ok=False, error="offload disabled")
+    if jaxlib_version is None:
+        jaxlib_version = local_jit_environment(backend).jaxlib_version
+    if not jaxlib_version:
+        return AotOutcome(ok=False, error="no local jaxlib version")
+    if timeout_s is None:
+        timeout_s = env_options.jit_timeout_s()
+    if not topologies:
+        return AotOutcome(ok=False, error="no topologies requested")
+
+    req = api.fanout.SubmitAotTaskRequest(
+        requestor_process_id=os.getpid(),
+        computation_digest=digest_bytes(computation),
+        backend=backend,
+        jaxlib_version=jaxlib_version,
+        cache_control=(env_options.cache_control()
+                       if cache_control is None else cache_control),
+    )
+    for topo in topologies:
+        t = req.topologies.add(device_count=topo.device_count,
+                               compile_options=bytes(
+                                   topo.compile_options))
+        t.mesh_shape.extend(topo.mesh_shape)
+    body = multi_chunk.make_multi_chunk_payload([
+        json_format.MessageToJson(req).encode(),
+        compress.compress(computation),
+    ])
+    resp = call_daemon("POST", "/local/submit_aot_task", body)
+    if resp.status != 200:
+        return AotOutcome(
+            ok=False, error=f"submit failed: HTTP {resp.status} "
+                            f"{resp.body[:200]!r}")
+    task_id = json_format.Parse(
+        resp.body, api.jit.SubmitJitTaskResponse()).task_id
+    return _wait(task_id, timeout_s)
+
+
+def _wait(task_id: int, timeout_s: float) -> AotOutcome:
+    msg, chunks, err = longpoll_task(
+        "/local/wait_for_aot_task", api.fanout.WaitForAotTaskRequest,
+        api.fanout.WaitForAotTaskResponse, task_id, timeout_s)
+    if msg is None:
+        return AotOutcome(ok=False, error=err)
+    artifacts: Dict[str, bytes] = {}
+    for key, chunk in zip(msg.artifact_keys, chunks[1:]):
+        data = compress.try_decompress(bytes(chunk))
+        if data is None:
+            return AotOutcome(
+                ok=False, error=f"corrupt artifact chunk {key!r}")
+        artifacts[key] = data
+    return AotOutcome(
+        ok=True, exit_code=msg.exit_code, error=msg.error,
+        artifacts=artifacts,
+        verdicts=[{
+            "child_key": v.child_key, "status": v.status,
+            "exit_code": v.exit_code, "attempts": v.attempts,
+            "error": v.error,
+        } for v in msg.verdicts])
+
+
+def topologies_for_mesh_family(
+    device_counts: Sequence[int],
+    compile_options: bytes = b"",
+) -> List[TopologySpec]:
+    """Convenience: the 1- and 2-level mesh shapes for each device
+    count, mirroring the ``partitioned_shard_bounds`` layouts of
+    parallel/mesh.py — a (N,) data mesh and, when N is an even
+    square-ish split, a (2, N/2) two-level variant."""
+    out: List[TopologySpec] = []
+    seen = set()
+
+    def add(shape: Tuple[int, ...], count: int) -> None:
+        spec = TopologySpec(mesh_shape=shape, device_count=count,
+                            compile_options=compile_options).validate()
+        if spec.digest() not in seen:
+            seen.add(spec.digest())
+            out.append(spec)
+
+    for n in device_counts:
+        add((n,), n)
+        if n % 2 == 0 and n >= 4:
+            add((2, n // 2), n)
+    return out

@@ -15,6 +15,18 @@ options-JSON:  {"backend": "cpu",
                 "compile_options_hex": "<CompileOptions proto hex>",
                 "mem_limit_bytes": 0}
 
+Two fan-out extensions (doc/workloads.md) share the protocol:
+
+  * AOT topology compiles add ``"mesh_shape": [2, 4],
+    "device_count": 8`` — the executable is built for that partition
+    count (num_partitions on the CompileOptions) instead of the
+    single-device default;
+  * autotune sweeps add ``"autotune_configs": [{...}, ...]`` — the
+    payload chunk is a kernel (Pallas / StableHLO template; ``{key}``
+    placeholders are instantiated from each config), every config is
+    evaluated, and artifact.bin holds the winning-config RECORD
+    (JSON: config, score, metric, evaluated) instead of an executable.
+
 Exit codes: 0 success, 1 compile/setup failure (diagnostics on stderr).
 ``--fake`` skips XLA entirely and writes a deterministic pseudo-artifact
 derived from the request digest — the control-plane twin used by the
@@ -72,13 +84,69 @@ def _fake_sleep() -> None:
 
 def _fake_artifact(options: dict, computation: bytes) -> bytes:
     """Deterministic stand-in artifact: digest-derived, content-unique
-    per (options, computation) so cache/dedup tests remain honest."""
+    per (options, computation) so cache/dedup tests remain honest.
+    The options dict carries the topology for AOT children, so two
+    topologies of the same module produce distinct artifacts."""
     from ..common.hashing import digest_keyed
 
     d = digest_keyed("ytpu-jit-fake-artifact",
                      json.dumps(options, sort_keys=True).encode(),
                      computation)
     return b"FAKEXLA1" + d.encode()
+
+
+def _config_score_fake(config: dict, kernel: bytes) -> float:
+    """Deterministic pseudo-score in [0, 1): digest-derived per
+    (config, kernel), so the sweep's winner is stable across hosts and
+    reruns — the property the dedup/cache tests lean on."""
+    from ..common.hashing import digest_keyed
+
+    d = digest_keyed("ytpu-autotune-fake-score",
+                     json.dumps(config, sort_keys=True).encode(), kernel)
+    return int(d[:12], 16) / float(1 << 48)
+
+
+def _instantiate_kernel(kernel: bytes, config: dict) -> bytes:
+    """Substitute ``{key}`` placeholders with the config's values —
+    the text-template convention that lets one kernel source span a
+    block/grid search space."""
+    text = kernel.decode(errors="replace")
+    for key, value in config.items():
+        text = text.replace("{%s}" % key, str(value))
+    return text.encode()
+
+
+def _sweep(options: dict, kernel: bytes, fake: bool) -> bytes:
+    """Evaluate every candidate config; returns the winner RECORD.
+
+    Real mode scores by compile wall time of the instantiated kernel
+    (a proxy — without input tensors the worker cannot time a real
+    run; deployments needing runtime-measured sweeps plug their own
+    worker, the record format doesn't change).  Fake mode scores by
+    digest.  Higher score wins in both."""
+    configs = options.get("autotune_configs") or []
+    if not configs:
+        raise ValueError("autotune request with no configs")
+    if fake:
+        _fake_sleep()
+    best = None
+    for config in configs:
+        if fake:
+            score = _config_score_fake(config, kernel)
+            metric = "fake_digest_score"
+        else:
+            import time
+
+            t0 = time.perf_counter()
+            _compile(dict(options, autotune_configs=None),
+                     _instantiate_kernel(kernel, config))
+            # Lower compile time -> higher score.
+            score = -(time.perf_counter() - t0)
+            metric = "neg_compile_seconds"
+        if best is None or score > best["score"]:
+            best = {"config": config, "score": score, "metric": metric}
+    best["evaluated"] = len(configs)
+    return json.dumps(best, sort_keys=True).encode()
 
 
 def _compile(options: dict, computation: bytes) -> bytes:
@@ -99,6 +167,18 @@ def _compile(options: dict, computation: bytes) -> bytes:
     blob = bytes.fromhex(options.get("compile_options_hex", ""))
     if blob:
         copts = xc.CompileOptions.ParseFromString(blob)
+    # AOT topology children: build for the requested partition count
+    # (the delegate fanned one submission into one child per topology;
+    # parallel/mesh.py's shard layouts are the client-side source of
+    # these shapes).
+    device_count = int(options.get("device_count", 0))
+    if device_count > 1:
+        copts.num_partitions = device_count
+        try:
+            copts.executable_build_options.num_partitions = device_count
+            copts.executable_build_options.use_spmd_partitioning = True
+        except AttributeError:
+            pass  # older xla_client: num_partitions alone suffices
     # StableHLO travels as text (Lowered.as_text()) or MLIR bytecode;
     # the XLA client accepts both forms through the same entry point.
     module = computation.decode() if _looks_textual(computation) \
@@ -127,7 +207,9 @@ def main() -> int:
     _apply_mem_limit(int(options.get("mem_limit_bytes", 0)))
     os.environ["JAX_PLATFORMS"] = options.get("backend", "cpu")
     try:
-        if args.fake:
+        if options.get("autotune_configs"):
+            artifact = _sweep(options, computation, fake=args.fake)
+        elif args.fake:
             _fake_sleep()
             artifact = _fake_artifact(options, computation)
         else:

@@ -114,7 +114,16 @@ def offload_compile(
     return _wait(task_id, timeout_s)
 
 
-def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
+def longpoll_task(route: str, wait_request_cls, response_cls,
+                  task_id: int, timeout_s: float):
+    """Long-poll one submitted task's wait route to completion.
+
+    Shared by every workload frontend (jit here, aot/autotune in
+    jit/aot.py and jit/autotune.py — their wait routes differ only in
+    message vocabulary).  Returns ``(msg, chunks, error)``: on success
+    msg is the parsed response and chunks the multi-chunk body views
+    (chunks[0] is the JSON); on infrastructure failure msg is None and
+    error says why."""
     import time
 
     from ..common.backoff import Backoff
@@ -128,16 +137,15 @@ def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            return OffloadOutcome(ok=False,
-                                  error=f"timed out after {timeout_s}s")
-        wreq = api.jit.WaitForJitTaskRequest(
+            return None, [], f"timed out after {timeout_s}s"
+        wreq = wait_request_cls(
             task_id=task_id,
             milliseconds_to_wait=min(_WAIT_LEG_MS,
                                      max(1, int(remaining * 1000))),
         )
         leg_start = time.monotonic()
         resp = call_daemon(
-            "POST", "/local/wait_for_jit_task",
+            "POST", route,
             json_format.MessageToJson(wreq).encode(),
             timeout_s=_WAIT_LEG_MS / 1000.0 + 10.0)
         if resp.status == 503:
@@ -145,29 +153,36 @@ def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
                 backoff.wait(resp.retry_after_s)
             else:
                 backoff.reset()  # a real long-poll leg: not a spin
-            continue  # still compiling
+            continue  # still running
         if resp.status != 200:
-            return OffloadOutcome(
-                ok=False, error=f"wait failed: HTTP {resp.status}")
+            return None, [], f"wait failed: HTTP {resp.status}"
         chunks = multi_chunk.try_parse_multi_chunk(resp.body)
         if not chunks:
-            return OffloadOutcome(ok=False, error="malformed wait reply")
-        msg = json_format.Parse(bytes(chunks[0]),
-                                api.jit.WaitForJitTaskResponse())
-        if msg.exit_code < 0:
-            # Daemon-side infrastructure failure (no grant, servant
-            # lost): fall back, this computation never compiled.
-            return OffloadOutcome(ok=False, exit_code=msg.exit_code,
-                                  error=msg.error)
-        artifacts: Dict[str, bytes] = {}
-        for key, chunk in zip(msg.artifact_keys, chunks[1:]):
-            data = compress.try_decompress(bytes(chunk))
-            if data is None:
-                return OffloadOutcome(
-                    ok=False, error=f"corrupt artifact chunk {key!r}")
-            artifacts[key] = data
-        return OffloadOutcome(ok=True, exit_code=msg.exit_code,
-                              error=msg.error, artifacts=artifacts)
+            return None, [], "malformed wait reply"
+        msg = json_format.Parse(bytes(chunks[0]), response_cls())
+        return msg, chunks, ""
+
+
+def _wait(task_id: int, timeout_s: float) -> OffloadOutcome:
+    msg, chunks, err = longpoll_task(
+        "/local/wait_for_jit_task", api.jit.WaitForJitTaskRequest,
+        api.jit.WaitForJitTaskResponse, task_id, timeout_s)
+    if msg is None:
+        return OffloadOutcome(ok=False, error=err)
+    if msg.exit_code < 0:
+        # Daemon-side infrastructure failure (no grant, servant
+        # lost): fall back, this computation never compiled.
+        return OffloadOutcome(ok=False, exit_code=msg.exit_code,
+                              error=msg.error)
+    artifacts: Dict[str, bytes] = {}
+    for key, chunk in zip(msg.artifact_keys, chunks[1:]):
+        data = compress.try_decompress(bytes(chunk))
+        if data is None:
+            return OffloadOutcome(
+                ok=False, error=f"corrupt artifact chunk {key!r}")
+        artifacts[key] = data
+    return OffloadOutcome(ok=True, exit_code=msg.exit_code,
+                          error=msg.error, artifacts=artifacts)
 
 
 def compile_lowered(lowered, *, backend: str = "cpu"):

@@ -186,17 +186,19 @@ class LocalCluster:
         self.cache_reader = DistributedCacheReader(self.cache_uri, "")
         self.running_keeper = RunningTaskKeeper(self.sched_uri,
                                                 refresh_interval_s=0.5)
+        # Persistent-compile-cache shim + fan-out parent fills, wired
+        # as entry.py wires them: reads through the delegate's
+        # Bloom-replicated reader, puts through a servant-role cache
+        # writer (the autotune sweep-level winner record rides this).
+        self.shim_cache_writer = DistributedCacheWriter(self.cache_uri,
+                                                        lambda: "")
         self.delegate = DistributedTaskDispatcher(
             grant_keeper=TaskGrantKeeper(self.sched_uri, ""),
             config_keeper=self.config_keeper,
             cache_reader=self.cache_reader,
             running_task_keeper=self.running_keeper,
+            cache_writer=self.shim_cache_writer,
         )
-        # Persistent-compile-cache shim plumbing, wired as entry.py
-        # wires it: reads through the delegate's Bloom-replicated
-        # reader, puts through a servant-role cache writer.
-        self.shim_cache_writer = DistributedCacheWriter(self.cache_uri,
-                                                        lambda: "")
         self.http = LocalHttpService(
             monitor=LocalTaskMonitor(nprocs=8, pid_prober=lambda p: True),
             digest_cache=FileDigestCache(),
@@ -250,6 +252,7 @@ class LocalCluster:
             config_keeper=self.config_keeper,
             cache_reader=self.cache_reader,
             running_task_keeper=keeper,
+            cache_writer=self.shim_cache_writer,
         )
 
     def stop(self):

@@ -24,6 +24,16 @@ Compiles are the deterministic fake worker (YTPU_JIT_FAKE_WORKER=1 for
 the cluster's lifetime): the farm is under test, not XLA.  Adds
 ``jit_compiles_per_sec`` and ``dedup_ratio`` (fraction of submissions
 that did NOT cost a servant compile) to the report.
+
+`--workload aot` and `--workload autotune` drive the fan-out kinds
+(doc/workloads.md): every submission is a PARENT that the delegate
+expands into per-topology compiles / per-slice sweeps, so the sim
+measures the one scheduler shape the 1:1 workloads never stress.
+Parents are Zipf-duplicated like the jit corpus; reports add
+``aot_topology_compiles_per_sec`` / ``autotune_sweeps_per_sec``, the
+fan-out width distribution, per-workload ``dedup_ratio`` (fraction of
+child resolutions that did NOT cost a servant compile), and explicit
+``lost_or_hung`` accounting.
 """
 
 from __future__ import annotations
@@ -113,6 +123,69 @@ def _zipf_picks(tasks: int, n_unique: int, rng):
     return picks
 
 
+# Topology family the AOT sim draws from: the 1- and 2-level mesh
+# shapes of parallel/mesh.py's partitioned_shard_bounds layouts.
+_AOT_TOPOLOGY_FAMILY = ((1,), (2,), (4,), (2, 2), (8,), (2, 4))
+
+
+def _make_aot_plans(n_unique: int, rng):
+    """Per-unique-parent topology lists (2..5 distinct topologies from
+    the family).  Duplicated parents reuse the SAME list — identical
+    submissions must produce identical child sets or the dedup
+    measurement lies."""
+    from ..jit.fanout import TopologySpec
+
+    plans = []
+    for _ in range(n_unique):
+        k = int(rng.integers(2, min(5, len(_AOT_TOPOLOGY_FAMILY)) + 1))
+        chosen = rng.choice(len(_AOT_TOPOLOGY_FAMILY), size=k,
+                            replace=False)
+        topos = []
+        for idx in sorted(int(i) for i in chosen):
+            shape = _AOT_TOPOLOGY_FAMILY[idx]
+            count = 1
+            for d in shape:
+                count *= d
+            topos.append(TopologySpec(mesh_shape=shape,
+                                      device_count=count).validate())
+        plans.append(topos)
+    return plans
+
+
+def _make_autotune_plans(n_unique: int, rng):
+    """Per-unique-kernel (config list, fan-out width) pairs: a small
+    block/grid cartesian space, swept 2..4 slices wide."""
+    from ..jit.autotune import SearchSpace
+
+    plans = []
+    for _ in range(n_unique):
+        blocks_m = [int(b) for b in
+                    rng.choice([32, 64, 128, 256], size=2, replace=False)]
+        blocks_n = [int(b) for b in
+                    rng.choice([32, 64, 128, 256], size=2, replace=False)]
+        grids = [int(g) for g in rng.choice([1, 2, 4, 8], size=2,
+                                            replace=False)]
+        configs = SearchSpace.of(block_m=sorted(blocks_m),
+                                 block_n=sorted(blocks_n),
+                                 grid=sorted(grids)).expand()
+        plans.append((configs, int(rng.integers(2, 5))))
+    return plans
+
+
+def _make_kernel_corpus(n_unique: int, rng):
+    """Unique synthetic kernel templates ({block_m}/{block_n}/{grid}
+    placeholders, Pallas-shaped text) at realistic sizes."""
+    body = (b"    acc = jnp.zeros(({block_m}, {block_n}), "
+            b"jnp.float32)  # grid {grid}\n") * 64
+    kernels = []
+    for i in range(n_unique):
+        head = (f"# kernel {i}\ndef matmul_kernel_{i}"
+                f"(x_ref, y_ref, o_ref):\n").encode()
+        size = int(rng.integers(2 << 10, 24 << 10))
+        kernels.append(head + body[:size])
+    return kernels
+
+
 def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
         policy: str, in_flight: int = 0, compile_s: float = 0.05,
         delegates: int = 1, tu_size_dist: str = "",
@@ -120,19 +193,22 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
     from ..common import compress
     from ..common.hashing import digest_bytes, digest_file
     from ..common.payload import copy_stats
+    from ..daemon.local.aot_task import AotBuildTask
+    from ..daemon.local.autotune_task import AutotuneSweepTask
     from ..daemon.local.cxx_task import CxxCompilationTask
     from ..daemon.local.jit_task import JitCompilationTask
     from ..jit.env import local_jit_environment
     from ..testing import LocalCluster, make_fake_compiler
 
-    if workload not in ("cxx", "jit"):
+    if workload not in ("cxx", "jit", "aot", "autotune"):
         raise ValueError(f"unknown workload {workload!r}")
+    worker_workloads = ("jit", "aot", "autotune")
     # NB: no "ytpu" in the path — CompilerRegistry treats paths
     # containing the client-wrapper markers as wrappers and skips them.
     tmp = Path(tempfile.mkdtemp(prefix="csim_"))
     saved_env = {k: os.environ.get(k)
                  for k in ("YTPU_JIT_FAKE_WORKER", "YTPU_JIT_FAKE_SLEEP_S")}
-    if workload == "jit":
+    if workload in worker_workloads:
         # Deterministic pseudo-compiles with the same duration knob the
         # fake g++ gets: measure the farm, not XLA.
         os.environ["YTPU_JIT_FAKE_WORKER"] = "1"
@@ -156,10 +232,18 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
     rng = np.random.default_rng(1)
     n_unique = max(1, int(tasks * (1.0 - dup_rate)))
-    if workload == "jit":
-        sources = _make_stablehlo_corpus(n_unique, rng)
+    aot_plans = tune_plans = None
+    if workload in worker_workloads:
         picks = _zipf_picks(tasks, n_unique, rng)
         jit_env = local_jit_environment("cpu")
+        if workload == "aot":
+            sources = _make_stablehlo_corpus(n_unique, rng)
+            aot_plans = _make_aot_plans(n_unique, rng)
+        elif workload == "autotune":
+            sources = _make_kernel_corpus(n_unique, rng)
+            tune_plans = _make_autotune_plans(n_unique, rng)
+        else:
+            sources = _make_stablehlo_corpus(n_unique, rng)
     else:
         sampler = _parse_tu_size_dist(tu_size_dist)
         if sampler is None:
@@ -187,6 +271,28 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
                 cache_control=1,
                 compressed_computation=compress.compress(src),
             )
+        if workload == "aot":
+            return AotBuildTask(
+                requestor_pid=1,
+                computation_digest=digest_bytes(src),
+                backend="cpu",
+                jaxlib_version=jit_env.jaxlib_version,
+                cache_control=1,
+                topologies=list(aot_plans[picks[i]]),
+                compressed_computation=compress.compress(src),
+            )
+        if workload == "autotune":
+            configs, width = tune_plans[picks[i]]
+            return AutotuneSweepTask(
+                requestor_pid=1,
+                kernel_digest=digest_bytes(src),
+                backend="cpu",
+                jaxlib_version=jit_env.jaxlib_version,
+                cache_control=1,
+                configs=list(configs),
+                fanout_width=width,
+                compressed_kernel=compress.compress(src),
+            )
         return CxxCompilationTask(
             requestor_pid=1,
             source_path=f"/src/tu{picks[i]}.cc",
@@ -199,10 +305,23 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
 
     # Like a build system's -j: keep some queuing pressure but don't
     # oversubscribe the rig (each in-flight TU is a thread + RPCs).
+    # Fan-out parents each expand into ~mean-width grant waiters, so
+    # the parent window shrinks by that factor — otherwise child-level
+    # demand runs at width× the other workloads' pressure and the
+    # scheduler's overload ladder (correctly) walks to REJECT and
+    # sheds the whole sim, which has no local-compile fallback to
+    # shed to.
     if not in_flight:
         in_flight = 2 * servants * concurrency
+        if workload == "aot":
+            mean_w = float(np.mean([len(p) for p in aot_plans]))
+            in_flight = max(2, int(in_flight / mean_w))
+        elif workload == "autotune":
+            mean_w = float(np.mean([w for _, w in tune_plans]))
+            in_flight = max(2, int(in_flight / mean_w))
     latencies = []
     failures = []
+    lost = []  # hung past every retry's generous timeout
     lock = threading.Lock()
     work = list(range(tasks))
 
@@ -221,7 +340,10 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
                 break
         dt = time.perf_counter() - t0
         with lock:
-            if result is None or result.exit_code != 0:
+            if result is None:
+                lost.append(i)
+                failures.append(i)
+            elif result.exit_code != 0:
                 failures.append(i)
             else:
                 latencies.append(dt)
@@ -290,6 +412,41 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
             out["servant_compiles"] = stats["actually_run"]
             out["dedup_ratio"] = round(
                 1.0 - stats["actually_run"] / max(1, resolved), 3)
+        if workload in ("aot", "autotune"):
+            # Fan-out provenance comes from the per-kind counters:
+            # children (and, for autotune, sweep-level parent hits)
+            # bump hit_cache/reused/actually_run through the normal
+            # dispatch path, so "resolved" counts every child verdict
+            # plus every whole-sweep cache shortcut.
+            kind = {k: sum(d.inspect()["stats_by_kind"]
+                           .get(workload, {}).get(k, 0)
+                           for d in all_delegates)
+                    for k in ("hit_cache", "reused", "actually_run",
+                              "failed")}
+            resolved = (kind["hit_cache"] + kind["reused"]
+                        + kind["actually_run"])
+            widths = [len(aot_plans[picks[i]]) if workload == "aot"
+                      else tune_plans[picks[i]][1]
+                      for i in range(tasks)]
+            out["breakdown"] = kind
+            out["lost_or_hung"] = len(lost)
+            out["servant_compiles"] = kind["actually_run"]
+            out["dedup_ratio"] = round(
+                1.0 - kind["actually_run"] / max(1, resolved), 3)
+            out["fanout_width"] = {
+                "min": int(np.min(widths)),
+                "p50": float(np.percentile(widths, 50)),
+                "mean": round(float(np.mean(widths)), 2),
+                "max": int(np.max(widths)),
+            }
+            if workload == "aot":
+                out["aot_topology_compiles_per_sec"] = round(
+                    resolved / wall, 1)
+            else:
+                out["autotune_sweeps_per_sec"] = round(tasks / wall, 1)
+                out["configs_evaluated"] = int(
+                    sum(len(tune_plans[picks[i]][0])
+                        for i in range(tasks)))
         if tu_size_dist:
             # Byte-heavy mode: the workload is about moving bytes, so
             # report how many moved and how often they were copied
@@ -322,6 +479,27 @@ def quick_jit_compiles_per_sec() -> float:
     return float(out["jit_compiles_per_sec"])
 
 
+def quick_aot_fanout_compiles_per_sec() -> float:
+    """bench.py's riding-along field for workload 3: topology results
+    delivered per second through the fan-out path (fake worker)."""
+    out = run(tasks=24, servants=2, concurrency=2, dup_rate=0.5,
+              policy="greedy_cpu", compile_s=0.0, workload="aot")
+    if out["failures"]:
+        raise RuntimeError(f"aot quick run failed: {out['failures']}")
+    return float(out["aot_topology_compiles_per_sec"])
+
+
+def quick_autotune_sweep_dedup_ratio() -> float:
+    """bench.py's riding-along field for workload 4: the dedup ratio
+    of a Zipf-duplicated sweep corpus (fake worker) — the cluster-wide
+    'measure once' claim in one number."""
+    out = run(tasks=24, servants=2, concurrency=2, dup_rate=0.5,
+              policy="greedy_cpu", compile_s=0.0, workload="autotune")
+    if out["failures"]:
+        raise RuntimeError(f"autotune quick run failed: {out['failures']}")
+    return float(out["dedup_ratio"])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser("ytpu-cluster-sim")
     ap.add_argument("--tasks", type=int, default=2000)
@@ -331,10 +509,13 @@ def main() -> int:
     ap.add_argument("--delegates", type=int, default=1,
                     help="simulated build machines (cross-machine dedup)")
     ap.add_argument("--policy", default="greedy_cpu")
-    ap.add_argument("--workload", default="cxx", choices=("cxx", "jit"),
-                    help="task corpus: C++ TUs, or a duplicate-heavy "
+    ap.add_argument("--workload", default="cxx",
+                    choices=("cxx", "jit", "aot", "autotune"),
+                    help="task corpus: C++ TUs, a duplicate-heavy "
                          "synthetic StableHLO corpus through the jit "
-                         "DistributedTask (doc/jit_offload.md)")
+                         "DistributedTask (doc/jit_offload.md), or the "
+                         "fan-out kinds — aot multi-topology builds / "
+                         "autotune sweeps (doc/workloads.md)")
     ap.add_argument("--tu-size-dist", default="",
                     help="TU size distribution: fixed:N, uniform:MIN:MAX,"
                          " or 'byte-heavy' (uniform 128KB..1MB)")
@@ -344,11 +525,13 @@ def main() -> int:
                     help="run a hostile-world scenario (or 'all') "
                          "instead of the friendly sweep: one of "
                          "wan-jitter, burst, flaky-servant, slow-loris, "
-                         "oversized-tu, cache-restart, overload-ladder "
+                         "oversized-tu, cache-restart, overload-ladder, "
+                         "aot-storm "
                          "(tools/scenarios.py, doc/robustness.md); "
                          "exits 1 on any SLO miss")
     ap.add_argument("--out", default="",
-                    help="with --scenario: write the JSON artifact here")
+                    help="write the JSON artifact here (scenario "
+                         "matrix or workload report)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: small run; exit 1 on any failure or, "
                          "for jit, if dedup never engaged")
@@ -363,7 +546,11 @@ def main() -> int:
             argv += ["--out", args.out]
         return scenarios.main(argv)
     if args.smoke:
-        args.tasks = min(args.tasks, 60)
+        # Fan-out parents each expand into several children: fewer
+        # parents keep the smoke gate's task count comparable.
+        args.tasks = min(args.tasks,
+                         30 if args.workload in ("aot", "autotune")
+                         else 60)
         args.servants = min(args.servants, 2)
         args.dup_rate = max(args.dup_rate, 0.5)
     out = run(args.tasks, args.servants, args.concurrency,
@@ -373,12 +560,19 @@ def main() -> int:
               tu_size_dist=args.tu_size_dist,
               workload=args.workload)
     print(json.dumps(out, indent=2))
+    if args.out:
+        Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
     if args.smoke:
         if out["failures"]:
             print(f"SMOKE FAILED: {out['failures']} failed tasks")
             return 1
-        if args.workload == "jit" and out["dedup_ratio"] <= 0:
-            print("SMOKE FAILED: duplicate-heavy jit run never deduped")
+        if args.workload in ("jit", "aot", "autotune") \
+                and out["dedup_ratio"] <= 0:
+            print(f"SMOKE FAILED: duplicate-heavy {args.workload} run "
+                  f"never deduped")
+            return 1
+        if out.get("lost_or_hung"):
+            print(f"SMOKE FAILED: {out['lost_or_hung']} lost/hung tasks")
             return 1
     return 0
 

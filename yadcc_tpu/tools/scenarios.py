@@ -31,6 +31,13 @@ Scenarios (``cluster_sim --scenario <name>|all``):
     overload-ladder  4x-capacity grant storm straight at the
                      scheduler; the admission ladder must walk
                      NORMAL -> ... -> REJECT and back, no flapping
+    aot-storm        one client submits 16-topology AOT fan-outs
+                     (doc/workloads.md) against a 4-slot pool while
+                     interactive jit clients keep compiling; the
+                     fairness-weight split (children inherit the
+                     parent's key at 1/width weight) must hold every
+                     victim at >= 80% of its share, and every parent
+                     must still complete with explicit verdicts
 
 Each scenario returns a JSON-able dict with its measurements, its SLO
 bounds, and a per-bound pass flag; ``run_matrix`` aggregates them into
@@ -41,6 +48,7 @@ counts for the CI gate in tools/ci.sh (fails on any SLO miss).
 from __future__ import annotations
 
 import json
+import os
 import random
 import tempfile
 import threading
@@ -57,7 +65,8 @@ from ..scheduler.admission import (RUNG_NAMES, RUNG_NORMAL, RUNG_REJECT,
                                    AdmissionConfig)
 
 SCENARIO_NAMES = ("wan-jitter", "burst", "flaky-servant", "slow-loris",
-                  "oversized-tu", "cache-restart", "overload-ladder")
+                  "oversized-tu", "cache-restart", "overload-ladder",
+                  "aot-storm")
 
 
 # --------------------------------------------------------------------------
@@ -748,6 +757,187 @@ def _scn_overload_ladder(smoke: bool) -> dict:
     return out
 
 
+def _scn_aot_storm(smoke: bool) -> dict:
+    """Fan-out/fairness interaction (doc/workloads.md): one bulk
+    client hammers the pool with 16-topology AOT submissions while
+    interactive jit clients keep submitting single compiles THROUGH
+    THE SAME env-matched grant queue.  Because fan-out children
+    inherit the parent requestor's fairness key and split its weight
+    (jit/fanout.py split_fairness), the whole storm draws one
+    submission's share per parent from FairGrantQueue — the victims'
+    interactive latency survives, and the parents still complete with
+    explicit per-child verdicts (starved children retry, then report
+    infra; nothing hangs)."""
+    from ..common import compress
+    from ..common.hashing import digest_bytes
+    from ..daemon.local.aot_task import AotBuildTask
+    from ..daemon.local.jit_task import JitCompilationTask
+    from ..jit.env import local_jit_environment
+    from ..jit.fanout import TopologySpec
+    from ..testing import LocalCluster
+
+    victim_tasks = 6 if smoke else 14
+    n_victims = 3
+    n_parents = 2 if smoke else 4
+    width = 16
+    saved = {k: os.environ.get(k)
+             for k in ("YTPU_JIT_FAKE_WORKER", "YTPU_JIT_FAKE_SLEEP_S")}
+    os.environ["YTPU_JIT_FAKE_WORKER"] = "1"
+    os.environ["YTPU_JIT_FAKE_SLEEP_S"] = "0.05"
+    tmp = Path(tempfile.mkdtemp(prefix="aotstorm_"))
+    cluster = LocalCluster(tmp, n_servants=2, policy="greedy_cpu",
+                           servant_concurrency=2)
+    env = local_jit_environment("cpu")
+    topologies = [TopologySpec(mesh_shape=(n,), device_count=n).validate()
+                  for n in range(1, width + 1)]
+
+    lock = threading.Lock()
+    victim_counts = {f"victim{i}": _Counts() for i in range(n_victims)}
+    parent_results: List[dict] = []
+
+    def submit(delegate, task, timeout_s: float):
+        tid = delegate.queue_task(task)
+        result = delegate.wait_for_task(tid, timeout_s)
+        delegate.free_task(tid)
+        return result
+
+    def victim_worker(idx: int):
+        name = f"victim{idx}"
+        pid = 2000 + idx
+        for i in range(victim_tasks):
+            hlo = (f"module @v{pid}_{i} {{ func.func public @main() "
+                   f"{{ return }} }}").encode()
+            t_sub = time.monotonic()
+            outcome = "lost"
+            for _ in range(3):
+                result = submit(cluster.delegate, JitCompilationTask(
+                    requestor_pid=pid,
+                    computation_digest=digest_bytes(hlo),
+                    compile_options=b"", backend="cpu",
+                    jaxlib_version=env.jaxlib_version,
+                    cache_control=0,
+                    compressed_computation=compress.compress(hlo),
+                ), timeout_s=60.0)
+                if result is None:
+                    break
+                if result.exit_code == 0:
+                    outcome = "remote"
+                    break
+                outcome = "infra"
+            if outcome == "infra":
+                outcome = "local"  # survival contract: compile locally
+            dt_ms = (time.monotonic() - t_sub) * 1000.0
+            with lock:
+                c = victim_counts[name]
+                c.submitted += 1
+                c.latencies.append(dt_ms)
+                if outcome == "remote":
+                    c.ok_remote += 1
+                elif outcome == "local":
+                    c.local_fallback += 1
+                else:
+                    c.lost_or_hung += 1
+
+    def adversary_worker(idx: int):
+        hlo = (f"module @storm{idx} {{ func.func public @main() "
+               f"{{ return }} }}").encode()
+        result = submit(cluster.delegate, AotBuildTask(
+            requestor_pid=666,
+            computation_digest=digest_bytes(hlo),
+            backend="cpu", jaxlib_version=env.jaxlib_version,
+            cache_control=0,
+            topologies=list(topologies),
+            compressed_computation=compress.compress(hlo),
+        ), timeout_s=300.0)
+        with lock:
+            parent_results.append({
+                "completed": result is not None,
+                "exit_code": (result.exit_code if result is not None
+                              else None),
+                "verdicts": (len(result.verdicts)
+                             if result is not None else 0),
+                "children_ok": (sum(1 for v in result.verdicts
+                                    if v.status in ("ok", "cached",
+                                                    "joined"))
+                                if result is not None else 0),
+            })
+
+    t0 = time.monotonic()
+    try:
+        threads = [threading.Thread(target=adversary_worker, args=(i,),
+                                    name=f"storm-parent-{i}", daemon=True)
+                   for i in range(n_parents)]
+        threads += [threading.Thread(target=victim_worker, args=(i,),
+                                     name=f"victim-{i}", daemon=True)
+                    for i in range(n_victims)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.monotonic() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        cluster.stop()
+
+    # Victim share, the oversized-tu measure: demand below fair share
+    # caps the achievable ratio at 1.0; a starved victim (lost tasks,
+    # unmet demand) drops below it.
+    total_units = (n_victims * victim_tasks + n_parents * width)
+    fair_share = total_units / (n_victims + 1)
+    shares = {}
+    for name, c in victim_counts.items():
+        served = c.ok_remote + c.local_fallback
+        shares[name] = round(served / min(fair_share,
+                                          max(1, c.submitted)), 3)
+    victim_lat = [l for c in victim_counts.values()
+                  for l in c.latencies]
+    out = {
+        "tasks": total_units,
+        "wall_seconds": round(wall, 2),
+        "storm_parents": n_parents,
+        "fanout_width": width,
+        "ok_remote": sum(c.ok_remote for c in victim_counts.values()),
+        "local_fallback": sum(c.local_fallback
+                              for c in victim_counts.values()),
+        "lost_or_hung": sum(c.lost_or_hung
+                            for c in victim_counts.values())
+        + sum(1 for p in parent_results if not p["completed"]),
+        "victim_latency_p50_ms": _pctl(victim_lat, 50),
+        "victim_latency_p99_ms": _pctl(victim_lat, 99),
+        "min_victim_share_ratio": round(min(shares.values()), 3),
+        "victim_share_ratio": shares,
+        "parents_completed": sum(1 for p in parent_results
+                                 if p["completed"]),
+        "parents_with_full_verdicts": sum(
+            1 for p in parent_results if p["verdicts"] == width),
+        "parent_children_ok_total": sum(p["children_ok"]
+                                        for p in parent_results),
+        "parent_results": parent_results,
+    }
+    out["compile_success_rate"] = round(
+        (out["ok_remote"] + out["local_fallback"])
+        / max(1, n_victims * victim_tasks), 4)
+    slo = {
+        "compile_success_rate_min": 0.99,
+        "lost_or_hung_max": 0,
+        # The fairness satellite's headline bound: no victim drops
+        # below 80% of its share under the fan-out storm.
+        "min_victim_share_ratio_min": 0.8,
+        # The storm itself must terminate with explicit verdicts —
+        # a hung parent is a fan-out bug, not a fairness win.
+        "parents_completed_min": n_parents,
+        "parents_with_full_verdicts_min": n_parents,
+        "victim_latency_p99_ms_max": 60_000.0,
+    }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
 def run_scenario(name: str, smoke: bool = False) -> dict:
     fn = {
         "wan-jitter": _scn_wan_jitter,
@@ -757,6 +947,7 @@ def run_scenario(name: str, smoke: bool = False) -> dict:
         "oversized-tu": _scn_oversized_tu,
         "cache-restart": _scn_cache_restart,
         "overload-ladder": _scn_overload_ladder,
+        "aot-storm": _scn_aot_storm,
     }[name]
     out = fn(smoke)
     out["scenario"] = name
