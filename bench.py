@@ -421,6 +421,18 @@ def main() -> None:
     except Exception:
         autotune_dedup = None
 
+    # Sharded control-plane canary (tools/pod_sim --shards,
+    # doc/scheduler.md "Sharded control plane"): grants/s through a
+    # small 4-shard ShardRouter on the full RPC grant path — the
+    # in-harness twin of artifacts/pod_sim_sharded.json's headline.
+    try:
+        from yadcc_tpu.tools.pod_sim import \
+            quick_sharded_assignments_per_sec
+
+        sharded_aps = round(quick_sharded_assignments_per_sec(), 1)
+    except Exception:
+        sharded_aps = None
+
     # Hostile-world survival canaries (tools/scenarios.py,
     # doc/robustness.md): the p99 latency of an explicit REJECT verdict
     # under a smoke 4x-overload ladder storm (a rejection is an
@@ -435,6 +447,11 @@ def main() -> None:
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 8 (r13+): adds `sharded_assignments_per_sec` — the
+        # sharded-control-plane canary (a 4-shard ShardRouter smoke
+        # through the full RPC grant path, tools/pod_sim;
+        # doc/benchmarks.md "Sharded control plane").  Every v7 field
+        # is still emitted.
         # Version 7 (r12+): adds `aot_fanout_compiles_per_sec` and
         # `autotune_sweep_dedup_ratio` — the fan-out workload canaries
         # (tools/cluster_sim --workload aot / autotune smoke runs;
@@ -460,7 +477,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 7,
+        "harness_version": 8,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -497,6 +514,7 @@ def main() -> None:
         "jit_compiles_per_sec": jit_cps,
         "aot_fanout_compiles_per_sec": aot_cps,
         "autotune_sweep_dedup_ratio": autotune_dedup,
+        "sharded_assignments_per_sec": sharded_aps,
         "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
         "survival_compile_success_rate": hostile.get(
             "survival_compile_success_rate"),

@@ -34,7 +34,8 @@ class TestMechanics:
         import threading
 
         threads = [threading.Thread(target=f, daemon=True) for f in
-                   (sim._completion_loop, sim._grant_pump)]
+                   (sim._completion_loop, sim._grant_pump,
+                    sim._binder_loop)]
         for t in threads:
             t.start()
         d = "a" * 64

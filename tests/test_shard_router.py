@@ -1,0 +1,461 @@
+"""Sharded scheduler control plane (scheduler/shard_router.py):
+consistent-hash routing invariants, cross-shard stealing (never a
+double-issued grant; parity oracle against the single dispatcher on
+the same seeded workload), aggregate-vs-per-shard inspect identity,
+and the device-sharded load summary (parallel/mesh.py)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from yadcc_tpu.common.consistent_hash import (SCHEDULER_VNODES_PER_WEIGHT,
+                                              ConsistentHash)
+from yadcc_tpu.scheduler.policy import make_policy
+from yadcc_tpu.scheduler.shard_router import ShardRouter, StealConfig
+from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+
+ENV = "e" * 64
+
+
+def _servant_keys(n):
+    return [f"10.{k >> 16 & 255}.{k >> 8 & 255}.{k & 255}:8335"
+            for k in range(n)]
+
+
+def _info(loc, cap=4, env=ENV):
+    return ServantInfo(
+        location=loc, version=1, num_processors=cap * 2, current_load=0,
+        dedicated=True, capacity=cap, total_memory=1 << 30,
+        memory_available=1 << 30, env_digests=(env,))
+
+
+def _mk_router(n_shards, *, steal=None, mesh=None, pool=256):
+    return ShardRouter.build(
+        lambda k: make_policy("greedy_cpu", max_servants=pool,
+                              avoid_self=False),
+        n_shards, max_servants_per_shard=pool,
+        steal=steal, mesh=mesh,
+        min_memory_for_new_task=1, batch_window_s=0.0)
+
+
+def _requestor_for_shard(router, shard, tag="delegate"):
+    for i in range(10000):
+        r = f"{tag}-{i}"
+        if router.shard_for_location(r) == shard:
+            return r
+    raise AssertionError("no requestor found for shard")
+
+
+class TestConsistentHashQuality:
+    """Satellite: weighted vnodes + remove_node/rebalance +
+    distribution quality (16 nodes within 1.25x max/min)."""
+
+    def test_16_node_share_within_1_25x(self):
+        ring = ConsistentHash(
+            [(f"shard{i}", 1) for i in range(16)],
+            vnodes_per_weight=SCHEDULER_VNODES_PER_WEIGHT)
+        from collections import Counter
+
+        shares = Counter(ring.pick(k) for k in _servant_keys(60000))
+        assert len(shares) == 16
+        assert max(shares.values()) / min(shares.values()) <= 1.25
+
+    def test_weighted_node_gets_proportional_share(self):
+        ring = ConsistentHash(
+            [("big", 2), ("small", 1)],
+            vnodes_per_weight=SCHEDULER_VNODES_PER_WEIGHT)
+        from collections import Counter
+
+        shares = Counter(ring.pick(k) for k in _servant_keys(40000))
+        ratio = shares["big"] / shares["small"]
+        assert 1.6 <= ratio <= 2.5
+
+    def test_remove_remaps_only_owned_keys(self):
+        ring = ConsistentHash([(f"n{i}", 1) for i in range(8)],
+                              vnodes_per_weight=256)
+        keys = _servant_keys(5000)
+        before = {k: ring.pick(k) for k in keys}
+        ring.remove_node("n3")
+        after = {k: ring.pick(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert moved, "n3 owned nothing — degenerate ring"
+        assert all(before[k] == "n3" for k in moved)
+        assert all(after[k] != "n3" for k in keys)
+        # Re-adding restores the exact original mapping (vnode points
+        # are a pure function of name + index).
+        ring.add_node("n3", 1)
+        assert {k: ring.pick(k) for k in keys} == before
+
+    def test_add_steals_only_what_it_owns(self):
+        ring = ConsistentHash([("a", 1), ("b", 1)],
+                              vnodes_per_weight=256)
+        keys = _servant_keys(3000)
+        before = {k: ring.pick(k) for k in keys}
+        ring.add_node("c", 1)
+        after = {k: ring.pick(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert all(after[k] == "c" for k in moved)
+
+    def test_reweight_and_validation(self):
+        ring = ConsistentHash([("a", 1)])
+        ring.add_node("a", 3)  # re-weight in place
+        assert ring.nodes() == {"a": 3}
+        with pytest.raises(ValueError):
+            ring.add_node("b", 0)
+        ring.remove_node("missing")  # idempotent no-op
+        with pytest.raises(ValueError):
+            ConsistentHash([], vnodes_per_weight=0)
+
+
+class TestRoutingInvariants:
+    """Satellite: every servant id maps to exactly one shard before
+    and after a shard join/leave."""
+
+    def test_every_servant_maps_to_exactly_one_shard(self):
+        router = _mk_router(4, steal=StealConfig(enabled=False))
+        try:
+            keys = _servant_keys(2000)
+            before = {k: router.shard_for_location(k) for k in keys}
+            assert all(0 <= s < 4 for s in before.values())
+            assert set(before.values()) == {0, 1, 2, 3}
+
+            router.ring_leave(2)
+            mid = {k: router.shard_for_location(k) for k in keys}
+            assert all(s in (0, 1, 3) for s in mid.values())
+            # Keys not owned by the leaver keep their mapping.
+            assert all(mid[k] == before[k] for k in keys
+                       if before[k] != 2)
+
+            router.ring_join(2)
+            after = {k: router.shard_for_location(k) for k in keys}
+            assert after == before
+        finally:
+            router.stop()
+
+    def test_cannot_drain_last_shard(self):
+        router = _mk_router(2, steal=StealConfig(enabled=False))
+        try:
+            router.ring_leave(0)
+            with pytest.raises(ValueError):
+                router.ring_leave(1)
+        finally:
+            router.stop()
+
+    def test_heartbeats_land_on_owning_shard(self):
+        router = _mk_router(4, steal=StealConfig(enabled=False))
+        try:
+            for loc in _servant_keys(64):
+                assert router.keep_servant_alive(_info(loc), 30.0)
+            for k, ins in enumerate(router.inspect()["per_shard"]):
+                for loc in ins["servants"]:
+                    assert router.shard_for_location(loc) == k
+        finally:
+            router.stop()
+
+
+class TestGrantIdNamespacing:
+    def test_stride_and_routing(self):
+        router = _mk_router(4)
+        try:
+            for loc in _servant_keys(32):
+                router.keep_servant_alive(_info(loc), 30.0)
+            got = router.wait_for_starting_new_task(
+                ENV, requestor="r-1", immediate=8, timeout_s=2.0)
+            assert got
+            for gid, _loc in got:
+                shard = router.shard_of_grant(gid)
+                # The owning dispatcher really holds it: a renewal
+                # routed by id alone succeeds.
+                assert router.keep_task_alive([gid], 15.0) == [True]
+                assert any(
+                    g.grant_id == gid
+                    for g in router.shards[shard].get_running_tasks())
+            router.free_task([gid for gid, _ in got])
+            assert router.inspect()["grants_outstanding"] == 0
+        finally:
+            router.stop()
+
+    def test_dispatcher_rejects_bad_namespacing(self):
+        with pytest.raises(ValueError):
+            TaskDispatcher(make_policy("greedy_cpu", max_servants=64,
+                                       avoid_self=False),
+                           max_servants=64, grant_id_start=5,
+                           grant_id_stride=4,
+                           start_dispatch_thread=False)
+        d = TaskDispatcher(make_policy("greedy_cpu", max_servants=64,
+                                       avoid_self=False),
+                           max_servants=64, grant_id_start=3,
+                           grant_id_stride=4,
+                           start_dispatch_thread=False,
+                           min_memory_for_new_task=1)
+        with pytest.raises(ValueError):
+            ShardRouter([d])  # stride 4 for a 1-shard router
+        d.stop()
+
+
+class TestStealing:
+    def test_steal_parity_oracle_no_double_issue(self):
+        """The same seeded workload through one dispatcher and through
+        a 4-shard router with a hot requestor: both grant every unit
+        of cluster capacity, the router's ids are globally unique, and
+        the steal path carried the overflow."""
+        rng = np.random.default_rng(11)
+        locs = _servant_keys(32)
+        caps = {loc: int(rng.integers(2, 6)) for loc in locs}
+        total_cap = sum(caps.values())
+
+        single = TaskDispatcher(
+            make_policy("greedy_cpu", max_servants=256,
+                        avoid_self=False),
+            max_servants=256, min_memory_for_new_task=1,
+            batch_window_s=0.0)
+        router = _mk_router(4)
+        try:
+            for loc in locs:
+                single.keep_servant_alive(_info(loc, caps[loc]), 60.0)
+                router.keep_servant_alive(_info(loc, caps[loc]), 60.0)
+            hot = _requestor_for_shard(router, 1)
+
+            # Sequential demand exactly equal to cluster capacity, all
+            # from one requestor (=> one home shard for the router).
+            demands = []
+            left = total_cap
+            while left > 0:
+                n = min(int(rng.integers(1, 8)), left)
+                demands.append(n)
+                left -= n
+
+            single_ids = []
+            routed_ids = []
+            stolen = 0
+            for n in demands:
+                s = single.wait_for_starting_new_task(
+                    ENV, requestor=hot, immediate=n, timeout_s=5.0)
+                r = router.wait_for_starting_new_task_routed(
+                    ENV, requestor=hot, immediate=n, timeout_s=5.0)
+                assert len(s) == n, "single dispatcher under-granted"
+                assert len(r.grants) == n, "router under-granted"
+                single_ids += [gid for gid, _ in s]
+                routed_ids += [g.grant_id for g in r.grants]
+                stolen += r.stolen_count
+
+            # Parity: both planes granted exactly cluster capacity.
+            assert len(single_ids) == len(routed_ids) == total_cap
+            # A stolen grant is never double-issued.
+            assert len(set(routed_ids)) == len(routed_ids)
+            assert len(set(single_ids)) == len(single_ids)
+            # The hot shard cannot hold 32 servants' capacity alone:
+            # stealing must have carried real load.
+            home_cap = sum(
+                caps[loc] for loc in locs
+                if router.shard_for_location(loc) == 1)
+            assert home_cap < total_cap
+            assert stolen >= total_cap - home_cap > 0
+            assert router.steal_stats()["stolen_grants"] == stolen
+            # Per-servant occupancy identical: every servant is at
+            # exactly its capacity on both planes.
+            def occupancy(disp_like):
+                occ = {}
+                for g in disp_like.get_running_tasks():
+                    occ[g.servant_location] = \
+                        occ.get(g.servant_location, 0) + 1
+                return occ
+
+            assert occupancy(single) == caps
+            assert occupancy(router) == caps
+        finally:
+            single.stop()
+            router.stop()
+
+    def test_steal_disabled_caps_hot_shard(self):
+        router = _mk_router(2, steal=StealConfig(enabled=False))
+        try:
+            for loc in _servant_keys(16):
+                router.keep_servant_alive(_info(loc, 2), 30.0)
+            hot = _requestor_for_shard(router, 0)
+            home_cap = sum(
+                2 for loc in _servant_keys(16)
+                if router.shard_for_location(loc) == 0)
+            got = router.wait_for_starting_new_task(
+                ENV, requestor=hot, immediate=32, timeout_s=0.4)
+            assert len(got) == home_cap < 32
+            assert router.steal_stats()["stolen_grants"] == 0
+        finally:
+            router.stop()
+
+    def test_dry_steal_is_paced(self):
+        cfg = StealConfig(donor_timeout_s=0.01,
+                          dry_backoff_initial_s=10.0,
+                          dry_backoff_max_s=10.0)
+        router = _mk_router(2, steal=cfg)
+        try:
+            hot = _requestor_for_shard(router, 0)
+            # No servants anywhere: the home shard is outrun by
+            # definition and no donor is eligible.
+            router.wait_for_starting_new_task(
+                ENV, requestor=hot, immediate=2, timeout_s=0.05)
+            router.wait_for_starting_new_task(
+                ENV, requestor=hot, immediate=2, timeout_s=0.05)
+            stats = router.steal_stats()
+            assert stats["steal_no_donor"] >= 1
+            assert stats["steal_paced"] >= 1
+            assert stats["stolen_grants"] == 0
+        finally:
+            router.stop()
+
+
+class TestAggregateInspect:
+    def test_aggregate_equals_sum_of_shards(self):
+        """Satellite fix: inspect() must aggregate across shards (sum
+        counters, max rung), not report one shard."""
+        router = _mk_router(4)
+        try:
+            for loc in _servant_keys(48):
+                router.keep_servant_alive(_info(loc), 30.0)
+            held = []
+            for i in range(6):
+                held += router.wait_for_starting_new_task(
+                    ENV, requestor=f"d-{i}", immediate=4, timeout_s=2.0)
+            router.free_task([gid for gid, _ in held[:5]])
+
+            ins = router.inspect()
+            per = ins["per_shard"]
+            assert len(per) == 4
+            assert ins["servants"] == sum(
+                len(p["servants"]) for p in per) == 48
+            assert ins["grants_outstanding"] == sum(
+                p["grants_outstanding"] for p in per) == len(held) - 5
+            for key in ("granted", "expired_grants", "zombies_killed"):
+                assert ins["stats"][key] == sum(
+                    p["stats"][key] for p in per)
+            assert ins["stats"]["granted"] == len(held)
+            assert ins["admission"]["rung"] == max(
+                p["admission"]["rung"] for p in per)
+            for key, v in ins["admission"]["stats"].items():
+                assert v == sum(p["admission"]["stats"][key]
+                                for p in per)
+            # Pooled stage percentiles exist for the dispatch stages.
+            assert "dispatch_cycle" in ins["latency_breakdown"]
+        finally:
+            router.stop()
+
+
+class TestMeshLoadSummary:
+    def test_device_rows_match_host_truth(self):
+        jax = pytest.importorskip("jax")
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >= 4 devices")
+        from yadcc_tpu.parallel.mesh import make_mesh
+
+        router = _mk_router(4, mesh=make_mesh(4))
+        try:
+            for loc in _servant_keys(32):
+                router.keep_servant_alive(_info(loc, 3), 30.0)
+            held = router.wait_for_starting_new_task(
+                ENV, requestor="d-1", immediate=5, timeout_s=2.0)
+            assert held
+            router.on_expiration_timer()
+            rows = router.mesh_loads()
+            assert rows is not None and rows.shape == (4, 3)
+            expect = []
+            for d in router.shards:
+                alive, cap, running = d.pool_load_arrays()
+                expect.append([
+                    int(alive.sum()),
+                    int(np.maximum(cap - running, 0)[alive].sum()),
+                    int(running[alive].sum()),
+                ])
+            assert rows.tolist() == expect
+            assert int(rows[:, 0].sum()) == 32
+            assert int(rows[:, 2].sum()) == len(held)
+        finally:
+            router.stop()
+
+    def test_mesh_shard_count_mismatch_rejected(self):
+        jax = pytest.importorskip("jax")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        from yadcc_tpu.parallel.mesh import make_mesh
+
+        with pytest.raises(ValueError):
+            _mk_router(3, mesh=make_mesh(2))
+
+
+class TestServiceIntegration:
+    def test_wire_carries_shard_and_steal_provenance(self):
+        from yadcc_tpu import api
+        from yadcc_tpu.rpc import (Channel, register_mock_server,
+                                   unregister_mock_server)
+        from yadcc_tpu.scheduler.service import SchedulerService
+
+        router = _mk_router(2)
+        name = f"shardsvc-{id(router):x}"
+        try:
+            for loc in _servant_keys(12):
+                router.keep_servant_alive(_info(loc, 2), 30.0)
+            svc = SchedulerService(router)
+            register_mock_server(name, svc.spec())
+            hot = _requestor_for_shard(router, 0)
+            chan = Channel(f"mock://{name}@{hot}")
+
+            req = api.scheduler.WaitForStartingTaskRequest(
+                token="", immediate_reqs=24,
+                milliseconds_to_wait=2000, next_keep_alive_in_ms=15000)
+            req.env_desc.compiler_digest = ENV
+            resp, _ = chan.call(
+                "ytpu.SchedulerService", "WaitForStartingTask", req,
+                api.scheduler.WaitForStartingTaskResponse)
+            assert resp.shard_id == 0
+            assert len(resp.grants) == 24
+            assert resp.stolen_grants == sum(
+                1 for g in resp.grants if g.stolen) > 0
+            for g in resp.grants:
+                assert g.shard_id == router.shard_of_grant(
+                    g.task_grant_id)
+                assert g.stolen == (g.shard_id != 0)
+
+            # Heartbeat answers the servant's owning shard.
+            hb = api.scheduler.HeartbeatRequest(
+                token="", next_heartbeat_in_ms=1000, version=1,
+                location="10.0.0.1:8335", num_processors=4, capacity=2,
+                total_memory_in_bytes=1 << 30,
+                memory_available_in_bytes=1 << 30)
+            hb.env_descs.add(compiler_digest=ENV)
+            hresp, _ = Channel(f"mock://{name}@10.0.0.1:8335").call(
+                "ytpu.SchedulerService", "Heartbeat", hb,
+                api.scheduler.HeartbeatResponse)
+            assert hresp.shard_id == router.shard_for_location(
+                "10.0.0.1:8335")
+            assert hresp.shard_redirect == ""
+        finally:
+            unregister_mock_server(name)
+            router.stop()
+
+
+class TestShardedPodSim:
+    def test_small_sharded_end_to_end(self):
+        from yadcc_tpu.tools.pod_sim import PodSim
+
+        sim = PodSim(servants=48, capacity=2, policy="greedy_cpu",
+                     exec_ms=20.0, churn_per_s=0, shards=4,
+                     hotspot="zipf:1.5", steal=True, delegates=16,
+                     hb_interval=0.5, mesh_loads="off",
+                     check_unique=True)
+        out = sim.run(800, dup_rate=0.2, submitters=4)
+        b = out["breakdown"]
+        assert out["tasks"] == 800
+        assert b["hit_cache"] + b["reused"] + b["actually_run"] == 800
+        sh = out["sharded"]
+        assert sh["shards"] == 4
+        assert sh["duplicate_grant_ids"] == 0
+        assert out["grants_granted"] == out["scheduler_stats"]["granted"]
+        assert sum(p["granted"] for p in sh["per_shard"]) == \
+            out["scheduler_stats"]["granted"]
+        assert sh["steal"]["stolen_grants"] > 0
+        assert 0.0 < sh["steal_rate"] <= 1.0
+        assert sh["demand_balance"] is not None
+        # Every shard that granted recorded its own stage breakdown.
+        for p in sh["per_shard"]:
+            if p["granted"]:
+                assert "dispatch_cycle" in p["latency_breakdown"]

@@ -105,6 +105,16 @@ if ! python -m yadcc_tpu.tools.cluster_sim --workload autotune --smoke; then
   fail=1
 fi
 
+echo "== sharded control-plane smoke =="
+# Sharded scheduler gate (doc/scheduler.md "Sharded control plane"): a
+# small hotspot-skewed 4-shard run asserting the plane's invariants —
+# the steal path engages, no grant id is ever double-issued, aggregate
+# counters == Σ per-shard, and no task is lost.
+if ! python -m yadcc_tpu.tools.pod_sim --shards 4 --smoke; then
+  echo "sharded pod_sim smoke FAILED" >&2
+  fail=1
+fi
+
 echo "== chaos smoke (hostile-world scenario gates) =="
 # Robustness gates (doc/robustness.md): a flaky servant must not cost
 # a single task (survival via retries + local fallback), and the

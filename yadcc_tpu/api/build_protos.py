@@ -192,12 +192,21 @@ def _scheduler_descriptor():
          ("running_tasks", 13, ".ytpu.api.RunningTask", "repeated"))
     _msg(fd, "HeartbeatResponse",
          ("acceptable_tokens", 1, "string", "repeated"),
-         ("expired_tasks", 2, "uint64", "repeated"))
+         ("expired_tasks", 2, "uint64", "repeated"),
+         # Sharded control plane (scheduler/shard_router.py): the
+         # servant's owning shard, + the redirect endpoint reserved for
+         # multi-process shard deployments.
+         ("shard_id", 3, "uint32"),
+         ("shard_redirect", 4, "string"))
     _msg(fd, "GetConfigRequest", ("token", 1, "string"))
     _msg(fd, "GetConfigResponse", ("serving_daemon_token", 1, "string"))
     _msg(fd, "StartingTaskGrant",
          ("task_grant_id", 1, "uint64"),
-         ("servant_location", 2, "string"))
+         ("servant_location", 2, "string"),
+         # Owning (issuing) shard; `stolen` marks grants pulled through
+         # the cross-shard steal channel (shard_id is then the donor).
+         ("shard_id", 3, "uint32"),
+         ("stolen", 4, "bool"))
     _msg(fd, "WaitForStartingTaskRequest",
          ("token", 1, "string"),
          ("milliseconds_to_wait", 2, "uint32"),
@@ -210,7 +219,11 @@ def _scheduler_descriptor():
          ("grants", 1, ".ytpu.api.StartingTaskGrant", "repeated"),
          ("flow_control", 2, "uint32"),
          ("retry_after_ms", 3, "uint32"),
-         ("degradation_rung", 4, "uint32"))
+         ("degradation_rung", 4, "uint32"),
+         # Home shard that served the request + how many of `grants`
+         # were stolen from donors on its behalf.
+         ("shard_id", 5, "uint32"),
+         ("stolen_grants", 6, "uint32"))
     _msg(fd, "KeepTaskAliveRequest",
          ("token", 1, "string"),
          ("task_grant_ids", 2, "uint64", "repeated"),

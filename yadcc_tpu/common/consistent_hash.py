@@ -1,16 +1,36 @@
 """Weighted consistent-hash ring, parity with reference
 yadcc/common/consistent_hash.h:33-71 (100 virtual nodes per weight unit).
-Used by the disk cache to pick a shard directory stably as shards come
-and go."""
+
+Two consumers with different balance requirements share this one
+implementation:
+
+* the disk cache picks a shard directory per key (the original user —
+  ``vnodes_per_weight`` defaults to the reference's 100);
+* the scheduler's sharded control plane routes servant heartbeats and
+  grant requests shard-ward (scheduler/shard_router.py), where the
+  acceptance bar is max/min key share within 1.25x across 16 shards —
+  that caller passes ``SCHEDULER_VNODES_PER_WEIGHT`` (512; measured
+  max/min ~1.14 on servant-id-shaped keys, vs ~1.48 at 100).
+
+Membership is mutable: ``add_node``/``remove_node`` rebalance
+incrementally with the classic consistent-hashing guarantee — removing
+a node remaps ONLY the keys that node owned, adding a node steals only
+the keys it now owns; every key unrelated to the change keeps its
+mapping (asserted in tests/test_shard_router.py)."""
 
 from __future__ import annotations
 
 import bisect
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import xxhash
 
 _VNODES_PER_WEIGHT = 100
+
+# Vnode density for shard routing (scheduler/shard_router.py): enough
+# points that the max/min key share across 16 equal-weight nodes stays
+# within 1.25x (doc/scheduler.md, "Sharded control plane").
+SCHEDULER_VNODES_PER_WEIGHT = 512
 
 
 def _hash(data: str) -> int:
@@ -18,15 +38,71 @@ def _hash(data: str) -> int:
 
 
 class ConsistentHash:
-    def __init__(self, nodes: Sequence[Tuple[str, int]]):
-        """nodes: (name, weight) pairs; weight units map to 100 vnodes."""
-        ring: List[Tuple[int, str]] = []
+    def __init__(self, nodes: Sequence[Tuple[str, int]],
+                 vnodes_per_weight: int = _VNODES_PER_WEIGHT):
+        """nodes: (name, weight) pairs; each weight unit maps to
+        ``vnodes_per_weight`` virtual nodes on the ring."""
+        if vnodes_per_weight <= 0:
+            raise ValueError("vnodes_per_weight must be positive")
+        self._vpw = vnodes_per_weight
+        self._weights: Dict[str, int] = {}
+        self._points: List[int] = []
+        self._names: List[str] = []
         for name, weight in nodes:
-            for i in range(weight * _VNODES_PER_WEIGHT):
-                ring.append((_hash(f"{name}#{i}"), name))
-        ring.sort()
-        self._points = [p for p, _ in ring]
-        self._names = [n for _, n in ring]
+            self.add_node(name, weight)
+
+    # -- membership --------------------------------------------------------
+
+    def add_node(self, name: str, weight: int = 1) -> None:
+        """Insert (or re-weight) a node.  Keys the new vnodes now own
+        move here; every other key keeps its mapping."""
+        if weight <= 0:
+            raise ValueError(f"weight must be positive: {name}={weight}")
+        if name in self._weights:
+            if self._weights[name] == weight:
+                return
+            self.remove_node(name)
+        pts = sorted((_hash(f"{name}#{i}"), name)
+                     for i in range(weight * self._vpw))
+        merged_p: List[int] = []
+        merged_n: List[str] = []
+        i = j = 0
+        while i < len(self._points) or j < len(pts):
+            if j >= len(pts) or (i < len(self._points)
+                                 and self._points[i] <= pts[j][0]):
+                merged_p.append(self._points[i])
+                merged_n.append(self._names[i])
+                i += 1
+            else:
+                merged_p.append(pts[j][0])
+                merged_n.append(pts[j][1])
+                j += 1
+        self._points = merged_p
+        self._names = merged_n
+        self._weights[name] = weight
+
+    def remove_node(self, name: str) -> None:
+        """Drop a node; ONLY the keys it owned remap (each to the next
+        surviving point clockwise).  Unknown names are a no-op so a
+        leave racing a crash-rejoin stays idempotent."""
+        if name not in self._weights:
+            return
+        del self._weights[name]
+        keep = [k for k, n in enumerate(self._names) if n != name]
+        self._points = [self._points[k] for k in keep]
+        self._names = [self._names[k] for k in keep]
+
+    def nodes(self) -> Dict[str, int]:
+        """Current membership: {name: weight}."""
+        return dict(self._weights)
+
+    def __len__(self) -> int:
+        return len(self._weights)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._weights
+
+    # -- lookup ------------------------------------------------------------
 
     def pick(self, key: str) -> str:
         if not self._points:

@@ -40,6 +40,10 @@ else:
 
 from ..models.cost import DEFAULT_COST_MODEL, DispatchCostModel
 from ..ops.assignment import NO_PICK, PoolArrays, TaskBatch, _scores
+# The one ceil-split layout shared by the Bloom filter shards and the
+# scheduler control-plane shards (re-exported: shard_router and the
+# control-plane helpers below derive their slot ranges from it).
+from ..ops.bloom_probe import partitioned_shard_bounds
 
 WORKER_AXIS = "workers"
 # Two-level meshes name the cross-host axis separately: collectives
@@ -473,3 +477,78 @@ def sharded_bloom_membership_fn(mesh: Mesh, *, length: int, num_bits: int,
 
 def pad_to_multiple(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
+
+
+# ----------------------------------------------------------------------
+# Sharded scheduler control plane (scheduler/shard_router.py).
+#
+# The servant pool of an N-shard control plane is ONE logical array
+# laid out by partitioned_shard_bounds: shard k owns global slots
+# [bounds[k], bounds[k+1]).  Each shard's dispatcher holds its slice
+# host-side (it is I/O-shaped lease state); the cross-shard LOAD view
+# — what the steal path ranks donors by — is device-sharded state:
+# the concatenated (alive, capacity, running) arrays are placed with a
+# NamedSharding over the mesh and reduced per-shard inside a
+# shard_map, so ranking 64 shards costs one tiny launch, not a host
+# loop over every shard's lock.
+# ----------------------------------------------------------------------
+
+
+def control_plane_shard_slices(
+        total_slots: int, n_shards: int) -> Tuple[Tuple[int, int], ...]:
+    """Slot ranges ((lo, hi), ...) per scheduler shard — the
+    partitioned_shard_bounds ceil-split layout applied to the servant
+    axis (32 "bits" per slot makes its word math the identity)."""
+    bounds = partitioned_shard_bounds(total_slots * 32, n_shards)
+    return tuple((bounds[k], bounds[k + 1]) for k in range(n_shards))
+
+
+def control_plane_pool_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding for the concatenated per-shard pool vectors: the
+    servant axis split over every mesh axis, one shard slice per
+    device (row-major — shard k's slice lands on linear device k, the
+    device_linear_index convention)."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
+def shard_pool_loads(mesh: Mesh, alive: np.ndarray, capacity: np.ndarray,
+                     running: np.ndarray):
+    """Place the concatenated control-plane load arrays device-sharded
+    (one shard slice per device).  Arrays must already be padded to an
+    exact multiple of the device count (control_plane_shard_slices
+    slices are equal-sized by construction; the router zero-pads the
+    tail shard — dead slots are alive=False and count nothing)."""
+    sh = control_plane_pool_sharding(mesh)
+    return (jax.device_put(alive, sh), jax.device_put(capacity, sh),
+            jax.device_put(running, sh))
+
+
+def shard_load_summary_fn(mesh: Mesh):
+    """Build the jitted per-shard load reducer: (alive bool[S],
+    effective_capacity int32[S], running int32[S]) sharded one shard
+    per device -> int32[n_shards, 3] rows of (alive_servants,
+    free_capacity, running_total).
+
+    Each device reduces ITS shard's slice locally and emits one row;
+    no collectives at all — the [n_shards, 3] result is itself sharded
+    on the shard axis and the host reads back 12 bytes per shard.  The
+    steal path ranks donors by row[1] (free capacity)."""
+    axes = tuple(mesh.axis_names)
+
+    def body(alive, capacity, running):
+        free = jnp.maximum(capacity - running, 0)
+        row = jnp.stack([
+            alive.sum().astype(jnp.int32),
+            jnp.where(alive, free, 0).sum().astype(jnp.int32),
+            jnp.where(alive, running, 0).sum().astype(jnp.int32),
+        ])
+        return row[None, :]
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), P(axes)),
+        out_specs=P(axes, None),
+        check_vma=False,
+    )
+    return jax.jit(fn)

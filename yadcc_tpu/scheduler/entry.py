@@ -40,6 +40,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "device kernel above (the measured winner, "
                         "artifacts/trace_ab.json)")
     p.add_argument("--max-servants", type=int, default=8192)
+    p.add_argument("--shards", type=int, default=1,
+                   help="scheduler control-plane shards (doc/scheduler.md "
+                        "\"Sharded control plane\"): N>1 partitions the "
+                        "servant pool over N PR-2 dispatchers routed by "
+                        "consistent hash, with cross-shard work stealing; "
+                        "--max-servants is the WHOLE fleet's pool, split "
+                        "per shard")
     p.add_argument("--min-daemon-version", type=int, default=0)
     p.add_argument("--acceptable-user-tokens", default="")
     p.add_argument("--acceptable-servant-tokens", default="")
@@ -101,26 +108,59 @@ def scheduler_start(args) -> None:
     install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
     ensure_policy_backend(args.dispatch_policy)
 
-    policy = make_policy(args.dispatch_policy, args.max_servants,
-                         avoid_self=not args.allow_self_dispatch)
-    depth = resolve_pipeline_depth(args.dispatch_pipeline_depth, policy)
-    # Pre-compile the policy's device kernels for the serving shapes
-    # BEFORE accepting requests: a mid-serving jit compile would stall
-    # a live grant cycle for hundreds of ms.
-    if depth > 0:
-        # Degradation lands on a HOST policy (AutoPolicy pins
-        # _device_dead; others are swapped for greedy_cpu), so the sync
-        # device ladder needs no warmup here.
-        policy.stream_warmup(args.max_servants)
+    if args.shards > 1:
+        # Sharded control plane (doc/scheduler.md): N PR-2 dispatchers
+        # on partitioned_shard_bounds slices of the pool, consistent-
+        # hash routing, cross-shard stealing.  Each shard owns its
+        # policy instance (device kernels are not shared across
+        # dispatch threads) and warms it before serving.
+        from ..parallel.mesh import control_plane_shard_slices
+        from .shard_router import ShardRouter
+
+        slices = control_plane_shard_slices(args.max_servants,
+                                            args.shards)
+        per_shard = max(hi - lo for lo, hi in slices)
+        policies = [
+            make_policy(args.dispatch_policy, per_shard,
+                        avoid_self=not args.allow_self_dispatch)
+            for _ in range(args.shards)
+        ]
+        depth = resolve_pipeline_depth(args.dispatch_pipeline_depth,
+                                       policies[0])
+        for pol in policies:
+            if depth > 0:
+                pol.stream_warmup(per_shard)
+            else:
+                pol.warmup(per_shard)
+        dispatcher = ShardRouter.build(
+            lambda k: policies[k], args.shards,
+            max_servants_per_shard=per_shard,
+            min_memory_for_new_task=parse_size(
+                args.servant_min_memory_for_new_task),
+            pipeline_depth=depth,
+        )
     else:
-        policy.warmup(args.max_servants)
-    dispatcher = TaskDispatcher(
-        policy,
-        max_servants=args.max_servants,
-        min_memory_for_new_task=parse_size(
-            args.servant_min_memory_for_new_task),
-        pipeline_depth=depth,
-    )
+        policy = make_policy(args.dispatch_policy, args.max_servants,
+                             avoid_self=not args.allow_self_dispatch)
+        depth = resolve_pipeline_depth(args.dispatch_pipeline_depth,
+                                       policy)
+        # Pre-compile the policy's device kernels for the serving
+        # shapes BEFORE accepting requests: a mid-serving jit compile
+        # would stall a live grant cycle for hundreds of ms.
+        if depth > 0:
+            # Degradation lands on a HOST policy (AutoPolicy pins
+            # _device_dead; others are swapped for greedy_cpu), so the
+            # sync device ladder needs no warmup here.
+            policy.stream_warmup(args.max_servants)
+        else:
+            policy.warmup(args.max_servants)
+        dispatcher = TaskDispatcher(
+            policy,
+            max_servants=args.max_servants,
+            min_memory_for_new_task=parse_size(
+                args.servant_min_memory_for_new_task),
+            pipeline_depth=depth,
+        )
     service = SchedulerService(
         dispatcher,
         user_tokens=make_token_verifier_from_flag(
@@ -152,8 +192,10 @@ def scheduler_start(args) -> None:
     server.start()
     inspect = InspectServer(args.inspect_port, args.inspect_credential)
     inspect.start()
-    logger.info("scheduler serving on :%d (policy=%s), inspect on :%d",
-                args.port, policy.name, inspect.port)
+    logger.info("scheduler serving on :%d (policy=%s, shards=%d), "
+                "inspect on :%d", args.port,
+                dispatcher.inspect()["policy"], args.shards,
+                inspect.port)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

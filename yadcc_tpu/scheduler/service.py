@@ -174,6 +174,12 @@ class SchedulerService:
         resp = api.scheduler.HeartbeatResponse()
         resp.acceptable_tokens.extend(self.daemon_tokens.acceptable())
         resp.expired_tasks.extend(expired)
+        # Sharded control plane: tell the servant its owning shard
+        # (shard_redirect stays unset — in-process routing;
+        # doc/scheduler.md "Sharded control plane").
+        shard_for = getattr(self.dispatcher, "shard_for_location", None)
+        if shard_for is not None:
+            resp.shard_id = shard_for(req.location)
         return resp
 
     def GetConfig(self, req, attachment, ctx):
@@ -199,12 +205,44 @@ class SchedulerService:
         # SHED_OPTIONAL drops only the opportunistic prefetch.
         decision = self.dispatcher.admission_check(
             immediate=req.immediate_reqs or 1,
-            prefetch=req.prefetch_reqs)
+            prefetch=req.prefetch_reqs,
+            requestor=ctx.peer)
         if decision.flow != admission.FLOW_NONE:
             resp = api.scheduler.WaitForStartingTaskResponse(
                 flow_control=decision.flow,
                 retry_after_ms=decision.retry_after_ms,
                 degradation_rung=decision.rung)
+            return resp
+        # Sharded control plane: the router resolves the home shard and
+        # may pull grants from donor shards (doc/scheduler.md); the
+        # provenance rides the response so delegates and dashboards can
+        # see stealing happen.  A plain dispatcher takes the old path.
+        routed_fn = getattr(
+            self.dispatcher, "wait_for_starting_new_task_routed", None)
+        if routed_fn is not None:
+            routed = routed_fn(
+                req.env_desc.compiler_digest,
+                min_version=max(req.min_version, self._min_version),
+                requestor=ctx.peer,
+                immediate=req.immediate_reqs or 1,
+                prefetch=(req.prefetch_reqs
+                          if decision.prefetch_allowed else 0),
+                lease_s=lease_ms / 1000.0,
+                timeout_s=wait_ms / 1000.0,
+            )
+            if not routed.grants:
+                raise RpcError(
+                    api.scheduler.SCHEDULER_STATUS_NO_QUOTA_AVAILABLE,
+                    "no capacity for environment")
+            resp = api.scheduler.WaitForStartingTaskResponse(
+                degradation_rung=decision.rung,
+                shard_id=routed.shard_id,
+                stolen_grants=routed.stolen_count)
+            for g in routed.grants:
+                resp.grants.add(task_grant_id=g.grant_id,
+                                servant_location=g.servant_location,
+                                shard_id=g.shard_id,
+                                stolen=g.stolen)
             return resp
         grants = self.dispatcher.wait_for_starting_new_task(
             req.env_desc.compiler_digest,

@@ -1,0 +1,615 @@
+"""Sharded scheduler control plane: N PR-2 dispatchers + routing + steal.
+
+The reference system is explicitly single-scheduler (COMPONENTS.md §2.3
+version-ledger note) and PR 2 drove that one dispatcher to p99 1.89ms at
+5k servants (artifacts/pod_sim_100k.json) — the ceiling ROADMAP's first
+open item names.  This module breaks it by making the control plane
+itself a sharded computation:
+
+* The servant pool is partitioned into N shards laid out by
+  ``parallel/mesh.py:partitioned_shard_bounds`` (the same ceil-split
+  the Bloom filter shards use); each shard runs the PR-2
+  ``TaskDispatcher`` — dirty-slot snapshots, staged heartbeat batching,
+  inline-leader dispatch, bounded-heap ``greedy_assign`` — UNCHANGED on
+  its slice.  A shard's lock now covers S/N servants, so lock hold
+  times, snapshot sizes, and policy batches all shrink by N.
+* Servant heartbeats and grant requests are routed shard-ward by the
+  weighted consistent hash (``common/consistent_hash.py``, scheduler
+  vnode density): a servant's location string owns exactly one shard,
+  before and after shard membership churn (``ring_join``/``ring_leave``
+  remap only the keys the affected shard owned).
+* Grant ids are namespaced by construction — shard k of N issues
+  k+1, k+1+N, k+1+2N, … — so a bare grant id routes its renewal/free
+  back to the owning shard (``shard_of_grant``) and a stolen grant can
+  never be re-issued by another shard: every grant exists in exactly
+  one dispatcher's registry.
+* Cross-shard work stealing: when a shard's queued-immediate backlog
+  outruns its free capacity (the ``scheduler/admission.py`` load
+  signal, re-exported as ``TaskDispatcher.load_signal``), the router
+  pulls grants for it from the least-loaded donor shard through a
+  bounded steal channel (semaphore-bounded concurrency, per-shard
+  ``common/backoff.py`` pacing on dry steals), so hot-spotted demand
+  does not re-create the single-scheduler bottleneck one shard at a
+  time.  A donor is only robbed while demonstrably underloaded
+  (utilization below ``donor_max_util`` with free capacity), which
+  structurally prevents steal ping-pong.
+* The cross-shard LOAD view is device-sharded state when a mesh is
+  available: the concatenated (alive, effective-capacity, running)
+  pool vectors are placed with a ``NamedSharding`` over the mesh and
+  reduced per-shard inside one ``shard_map`` launch
+  (``parallel/mesh.py:shard_load_summary_fn``), refreshed from the
+  expiration sweep and surfaced in ``inspect()``.
+
+``inspect()`` aggregates across shards — counters sum, the admission
+rung is the max over shards, stage percentiles pool every shard's
+samples — with the per-shard detail under ``per_shard``
+(doc/scheduler.md, "Sharded control plane").
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.backoff import Backoff
+from ..common.consistent_hash import (SCHEDULER_VNODES_PER_WEIGHT,
+                                      ConsistentHash)
+from ..utils.clock import REAL_CLOCK, Clock
+from ..utils.logging import get_logger
+from .admission import RUNG_NAMES, AdmissionDecision
+from .task_dispatcher import ServantInfo, TaskDispatcher
+
+logger = get_logger("scheduler.shard_router")
+
+
+@dataclass
+class StealConfig:
+    """Cross-shard steal tuning (doc/scheduler.md)."""
+
+    enabled: bool = True
+    # A donor must sit below this utilization (and have free capacity,
+    # and an EMPTY immediate queue — the real "the donor needs it
+    # itself" signal, and what structurally prevents ping-pong: a shard
+    # with queued demand is never robbed).  1.0 means stealing may
+    # drain a donor to its last free slot; lower it to reserve donor
+    # headroom at the cost of stranding that fraction of the fleet
+    # under skew.
+    donor_max_util: float = 1.0
+    # Most grants one steal op may pull (bounds how much of a donor's
+    # capacity a single hot requestor can drain per op).
+    max_batch: int = 64
+    # Concurrent steal ops across the whole router (the bounded steal
+    # channel): excess demand falls back to the home shard's queue.
+    channel_bound: int = 4
+    # Donor-side wait bound per steal op — a donor with free capacity
+    # answers inline (inline-leader dispatch); one without must not
+    # park the thief for long.
+    donor_timeout_s: float = 0.05
+    # Pacing for DRY steals (nothing stolen): per-home-shard backoff so
+    # a starved fleet does not hammer its neighbours' locks.
+    dry_backoff_initial_s: float = 0.005
+    dry_backoff_max_s: float = 0.25
+    # Load-signal cache refresh period (donor ranking reads the cache;
+    # at 5k req/s the router must not take N dispatcher locks per
+    # request).
+    load_refresh_s: float = 0.02
+    # Minimum period between device-sharded load-summary launches
+    # (observability; the gather touches every shard's lock and the
+    # launch itself is a multi-ms burst on a small host — 0.1Hz
+    # freshness is plenty for dashboards).
+    mesh_refresh_min_s: float = 10.0
+
+
+@dataclass
+class RoutedGrant:
+    """One grant plus its provenance on the sharded plane."""
+
+    grant_id: int
+    servant_location: str
+    shard_id: int          # shard whose dispatcher issued (owns) it
+    stolen: bool           # True when shard_id != the serving shard
+
+
+@dataclass
+class RoutedGrants:
+    """wait_for_starting_new_task_routed result."""
+
+    shard_id: int                  # home (serving) shard
+    grants: List[RoutedGrant] = field(default_factory=list)
+
+    def pairs(self) -> List[Tuple[int, str]]:
+        return [(g.grant_id, g.servant_location) for g in self.grants]
+
+    @property
+    def stolen_count(self) -> int:
+        return sum(1 for g in self.grants if g.stolen)
+
+
+class ShardRouter:
+    """N TaskDispatchers behind the single-dispatcher surface
+    SchedulerService (and the sims) consume.
+
+    The router's own lock is a LEAF guarding counters and caches; it is
+    never held across a shard dispatcher call, so it can never nest
+    with (or deadlock against) any dispatcher's lock."""
+
+    def __init__(
+        self,
+        shards: Sequence[TaskDispatcher],
+        *,
+        clock: Clock = REAL_CLOCK,
+        steal: Optional[StealConfig] = None,
+        mesh=None,
+        vnodes_per_weight: int = SCHEDULER_VNODES_PER_WEIGHT,
+    ):
+        if not shards:
+            raise ValueError("need at least one shard")
+        n = len(shards)
+        for k, d in enumerate(shards):
+            if (d._grant_id_stride != n
+                    or d._next_grant_id % n != (k + 1) % n):
+                raise ValueError(
+                    f"shard {k} must be built with grant_id_start={k + 1} "
+                    f"grant_id_stride={n} (use ShardRouter.build)")
+        self._shards = list(shards)
+        self._clock = clock
+        self._cfg = steal or StealConfig()
+        self._ring = ConsistentHash(
+            [(self._ring_name(k), 1) for k in range(n)],
+            vnodes_per_weight=vnodes_per_weight)
+
+        self._lock = threading.Lock()
+        self._rr = itertools.count()  # guarded by: self._lock
+        self._stats = {
+            "steals_attempted": 0,
+            "stolen_grants": 0,
+            "steal_dry": 0,
+            "steal_paced": 0,
+            "steal_channel_full": 0,
+            "steal_no_donor": 0,
+        }  # guarded by: self._lock
+        self._loads: Optional[List] = None  # guarded by: self._lock
+        self._loads_at = -1.0  # guarded by: self._lock
+        # now-timestamp before which shard k must not attempt another
+        # steal (set on dry steals from its Backoff schedule).
+        self._steal_next_ok = [0.0] * n  # guarded by: self._lock
+        self._steal_backoffs = [
+            Backoff(initial_s=self._cfg.dry_backoff_initial_s,
+                    max_s=self._cfg.dry_backoff_max_s,
+                    sleep=lambda _s: None)
+            for _ in range(n)
+        ]  # guarded by: self._lock
+        # The bounded steal channel.
+        self._steal_sem = threading.BoundedSemaphore(
+            self._cfg.channel_bound)
+
+        # Device-sharded load summary (optional fast path): one
+        # shard_map launch reduces every shard's pool slice to an
+        # (alive, free, running) row.  Refreshed from the expiration
+        # sweep; read by inspect() and the donor ranking when fresher
+        # than the host cache.
+        self._mesh = mesh
+        self._mesh_fn = None
+        self._mesh_rows: Optional[np.ndarray] = None  # guarded by: self._lock
+        self._mesh_at = -1.0  # guarded by: self._lock
+        if mesh is not None:
+            from ..parallel.mesh import shard_load_summary_fn
+
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            if n_dev != n:
+                raise ValueError(
+                    f"mesh has {n_dev} devices for {n} shards; the "
+                    "control-plane layout is one shard slice per device")
+            self._mesh_fn = shard_load_summary_fn(mesh)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, policy_factory, n_shards: int, *,
+              max_servants_per_shard: int = 8192,
+              clock: Clock = REAL_CLOCK,
+              steal: Optional[StealConfig] = None,
+              mesh=None,
+              **dispatcher_kwargs) -> "ShardRouter":
+        """Construct the N shard dispatchers with the grant-id
+        namespacing the router requires.  ``policy_factory(k)`` builds
+        shard k's DispatchPolicy (each shard owns its policy instance —
+        device kernels must not be shared across dispatch threads)."""
+        shards = [
+            TaskDispatcher(
+                policy_factory(k),
+                max_servants=max_servants_per_shard,
+                clock=clock,
+                grant_id_start=k + 1,
+                grant_id_stride=n_shards,
+                **dispatcher_kwargs,
+            )
+            for k in range(n_shards)
+        ]
+        return cls(shards, clock=clock, steal=steal, mesh=mesh)
+
+    # -- routing ------------------------------------------------------------
+
+    @staticmethod
+    def _ring_name(k: int) -> str:
+        return f"shard{k}"
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shards)
+
+    @property
+    def shards(self) -> Tuple[TaskDispatcher, ...]:
+        return tuple(self._shards)
+
+    def shard_for_location(self, location: str) -> int:
+        """Owning shard for a servant id — THE routing function: every
+        servant id maps to exactly one shard, before and after shard
+        membership churn (tests/test_shard_router.py invariants)."""
+        return int(self._ring.pick(location)[len("shard"):])
+
+    def _route_request(self, requestor: str) -> int:
+        """Home shard for a grant request: the requestor's consistent-
+        hash shard (delegates are pinned, so their keep-alive/free
+        traffic and their grants co-locate), round-robin when the
+        caller is anonymous."""
+        if requestor:
+            return self.shard_for_location(requestor)
+        with self._lock:
+            return next(self._rr) % len(self._shards)
+
+    def shard_of_grant(self, grant_id: int) -> int:
+        """Owning shard from the id alone (the namespacing invariant:
+        shard k issues ids ≡ k+1 mod N)."""
+        return (int(grant_id) - 1) % len(self._shards)
+
+    def ring_join(self, shard_id: int, weight: int = 1) -> None:
+        """(Re-)enter a shard into the routing ring.  Only the keys the
+        new vnodes own move; used for membership churn and tested for
+        the exactly-one-shard invariant."""
+        self._ring.add_node(self._ring_name(shard_id), weight)
+
+    def ring_leave(self, shard_id: int) -> None:
+        """Drain routing away from a shard (decommission): its servants
+        remap to surviving shards on their next heartbeat; its standing
+        registrations age out by lease.  Grant-id routing is untouched
+        — outstanding grants stay renewable on the owning dispatcher
+        until freed."""
+        if len(self._ring) <= 1:
+            raise ValueError("cannot drain the last shard")
+        self._ring.remove_node(self._ring_name(shard_id))
+
+    # -- TaskDispatcher surface (SchedulerService + sims) -------------------
+
+    def keep_servant_alive(self, info: ServantInfo,
+                           expires_in_s: float) -> bool:
+        return self._shards[self.shard_for_location(info.location)] \
+            .keep_servant_alive(info, expires_in_s)
+
+    def notify_servant_running_tasks(
+            self, location: str, reported_grant_ids: Sequence[int]
+    ) -> List[int]:
+        return self._shards[self.shard_for_location(location)] \
+            .notify_servant_running_tasks(location, reported_grant_ids)
+
+    def admission_check(self, immediate: int = 1, prefetch: int = 0,
+                        requestor: str = "") -> AdmissionDecision:
+        """Rule on the HOME shard's ladder — the shard this requestor's
+        grants queue on.  Shards shed independently: a hot shard that
+        stealing cannot relieve degrades alone instead of dragging the
+        healthy ones with it."""
+        return self._shards[self._route_request(requestor)] \
+            .admission_check(immediate, prefetch)
+
+    def wait_for_starting_new_task(self, env_digest: str, *,
+                                   min_version: int = 0,
+                                   requestor: str = "",
+                                   immediate: int = 1,
+                                   prefetch: int = 0,
+                                   lease_s: float = 15.0,
+                                   timeout_s: float = 5.0,
+                                   ) -> List[Tuple[int, str]]:
+        return self.wait_for_starting_new_task_routed(
+            env_digest, min_version=min_version, requestor=requestor,
+            immediate=immediate, prefetch=prefetch, lease_s=lease_s,
+            timeout_s=timeout_s).pairs()
+
+    def wait_for_starting_new_task_routed(self, env_digest: str, *,
+                                          min_version: int = 0,
+                                          requestor: str = "",
+                                          immediate: int = 1,
+                                          prefetch: int = 0,
+                                          lease_s: float = 15.0,
+                                          timeout_s: float = 5.0,
+                                          ) -> RoutedGrants:
+        """The sharded grant path: steal first when the home shard is
+        demonstrably outrun, then the normal PR-2 blocking allocation
+        on the home shard for the remainder."""
+        home = self._route_request(requestor)
+        d = self._shards[home]
+        out = RoutedGrants(shard_id=home)
+        need = max(0, immediate)
+        t0 = self._clock.now()
+        if self._cfg.enabled and need > 0 and len(self._shards) > 1:
+            sig = d.load_signal()
+            if sig.queued_immediate + need > sig.free:
+                # Pull from donors until the demand fits or they run
+                # dry; each op targets the CURRENT least-loaded donor
+                # (a successful op invalidates the load cache, so the
+                # next pick sees the drain it caused).  Bounded: at
+                # most one op per shard per request.
+                for _ in range(len(self._shards) - 1):
+                    if need <= 0:
+                        break
+                    got = self._try_steal(
+                        home, env_digest, min_version, requestor,
+                        min(need, self._cfg.max_batch), lease_s)
+                    if not got:
+                        break
+                    for gid, loc, donor in got:
+                        out.grants.append(
+                            RoutedGrant(gid, loc, donor, True))
+                        need -= 1
+        if need > 0:
+            remaining = max(0.0, timeout_s - (self._clock.now() - t0))
+            for gid, loc in d.wait_for_starting_new_task(
+                    env_digest, min_version=min_version,
+                    requestor=requestor, immediate=need,
+                    prefetch=prefetch, lease_s=lease_s,
+                    timeout_s=remaining):
+                out.grants.append(RoutedGrant(gid, loc, home, False))
+        return out
+
+    def keep_task_alive(self, grant_ids: Sequence[int],
+                        next_keep_alive_s: float) -> List[bool]:
+        out = [False] * len(grant_ids)
+        by_shard: Dict[int, List[Tuple[int, int]]] = defaultdict(list)
+        for i, gid in enumerate(grant_ids):
+            by_shard[self.shard_of_grant(gid)].append((i, gid))
+        for s, items in by_shard.items():
+            res = self._shards[s].keep_task_alive(
+                [gid for _, gid in items], next_keep_alive_s)
+            for (i, _), ok in zip(items, res):
+                out[i] = ok
+        return out
+
+    def free_task(self, grant_ids: Sequence[int]) -> None:
+        by_shard: Dict[int, List[int]] = defaultdict(list)
+        for gid in grant_ids:
+            by_shard[self.shard_of_grant(gid)].append(gid)
+        for s, ids in by_shard.items():
+            self._shards[s].free_task(ids)
+
+    def get_running_tasks(self) -> List:
+        out: List = []
+        for d in self._shards:
+            out.extend(d.get_running_tasks())
+        return out
+
+    def on_expiration_timer(self) -> None:
+        for d in self._shards:
+            d.on_expiration_timer()
+        if self._mesh_fn is not None:
+            now = self._clock.now()
+            with self._lock:
+                due = (self._mesh_at < 0
+                       or now - self._mesh_at
+                       >= self._cfg.mesh_refresh_min_s)
+                if due:
+                    self._mesh_at = now
+            if not due:
+                return
+            try:
+                self._refresh_mesh_loads()
+            except Exception:
+                # The device summary is observability/fast-path only:
+                # a wedged device must not take the sweep (and with it
+                # every lease) down.
+                logger.exception("mesh load summary failed; "
+                                 "falling back to host loads")
+                self._mesh_fn = None
+
+    def run_dispatch_cycle_for_testing(self) -> int:
+        return sum(d.run_dispatch_cycle_for_testing()
+                   for d in self._shards)
+
+    def stop(self) -> None:
+        for d in self._shards:
+            d.stop()
+
+    # -- stealing -----------------------------------------------------------
+
+    def _shard_loads(self, now: float) -> List:
+        with self._lock:
+            if (self._loads is not None
+                    and now - self._loads_at < self._cfg.load_refresh_s
+                    and self._loads_at <= now):
+                return self._loads
+        # Outside the router lock: load_signal takes each dispatcher's
+        # lock (leaf discipline — never nested under ours).  Concurrent
+        # refreshes are benign; last writer wins.
+        loads = [d.load_signal() for d in self._shards]
+        with self._lock:
+            self._loads = loads
+            self._loads_at = now
+        return loads
+
+    def _pick_donor(self, home: int,
+                    now: float) -> Tuple[Optional[int], int]:
+        """Least-loaded eligible donor: underloaded, idle queue, free
+        capacity; ties broken toward the most free capacity.  Returns
+        (donor, free) so the steal op can clamp to what is actually
+        there instead of parking on a drained donor."""
+        cfg = self._cfg
+        loads = self._shard_loads(now)
+        best, best_free = None, 0
+        for k, sig in enumerate(loads):
+            if k == home or sig.free <= 0 or sig.queued_immediate > 0:
+                continue
+            if sig.utilization >= cfg.donor_max_util:
+                continue
+            if sig.free > best_free:
+                best, best_free = k, sig.free
+        return best, best_free
+
+    def _try_steal(self, home: int, env_digest: str, min_version: int,
+                   requestor: str, want: int, lease_s: float,
+                   ) -> List[Tuple[int, str, int]]:
+        """One bounded steal op on behalf of shard `home`; returns
+        [(grant_id, servant_location, donor_shard)].  The grants are
+        issued by the DONOR's dispatcher through its normal path, so
+        they live in exactly one registry and renew/free by id."""
+        cfg = self._cfg
+        now = self._clock.now()
+        with self._lock:
+            if now < self._steal_next_ok[home]:
+                self._stats["steal_paced"] += 1
+                return []
+        if not self._steal_sem.acquire(blocking=False):
+            with self._lock:
+                self._stats["steal_channel_full"] += 1
+            return []
+        try:
+            donor, donor_free = self._pick_donor(home, now)
+            if donor is None:
+                with self._lock:
+                    self._stats["steal_no_donor"] += 1
+                self._note_dry_locked_free(home, now)
+                return []
+            with self._lock:
+                self._stats["steals_attempted"] += 1
+            got = self._shards[donor].wait_for_starting_new_task(
+                env_digest, min_version=min_version, requestor=requestor,
+                immediate=min(want, donor_free), prefetch=0,
+                lease_s=lease_s, timeout_s=cfg.donor_timeout_s)
+            if got:
+                with self._lock:
+                    self._stats["stolen_grants"] += len(got)
+                    self._steal_backoffs[home].reset()
+                    self._steal_next_ok[home] = 0.0
+                    # The donor's free capacity just moved; make the
+                    # next donor pick see it.
+                    self._loads_at = -1.0
+            else:
+                with self._lock:
+                    self._stats["steal_dry"] += 1
+                self._note_dry_locked_free(home, now)
+            return [(gid, loc, donor) for gid, loc in got]
+        finally:
+            self._steal_sem.release()
+
+    def _note_dry_locked_free(self, home: int, now: float) -> None:
+        with self._lock:
+            delay = self._steal_backoffs[home].next_delay()
+            self._steal_next_ok[home] = now + delay
+
+    # -- device-sharded load view -------------------------------------------
+
+    def _refresh_mesh_loads(self) -> None:
+        """One shard_map launch over the device-sharded pool state:
+        gather each shard's (alive, capacity, running) slice, pad to
+        the common slice width, place with the control-plane
+        NamedSharding, reduce per-shard on device."""
+        from ..parallel.mesh import shard_pool_loads
+
+        slices = [d.pool_load_arrays() for d in self._shards]
+        per = max(a.shape[0] for a, _, _ in slices)
+
+        def cat(i, dtype):
+            return np.concatenate([
+                np.pad(s[i], (0, per - s[i].shape[0]))
+                for s in slices
+            ]).astype(dtype)
+
+        alive, cap, running = (cat(0, bool), cat(1, np.int32),
+                               cat(2, np.int32))
+        a, c, r = shard_pool_loads(self._mesh, alive, cap, running)
+        rows = np.asarray(self._mesh_fn(a, c, r))
+        with self._lock:
+            self._mesh_rows = rows
+
+    def mesh_loads(self) -> Optional[np.ndarray]:
+        """Latest device-computed [n_shards, 3] (alive, free, running)
+        rows, or None before the first sweep / without a mesh."""
+        with self._lock:
+            return None if self._mesh_rows is None \
+                else self._mesh_rows.copy()
+
+    # -- observability ------------------------------------------------------
+
+    def steal_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def inspect(self) -> dict:
+        """Aggregate view: counters SUM across shards, the admission
+        rung is the MAX over shards (the fleet is as degraded as its
+        most degraded shard), stage percentiles pool every shard's
+        retained samples.  Per-shard detail rides under ``per_shard``;
+        the aggregate == Σ per-shard identity is asserted in
+        tests/test_shard_router.py."""
+        per_shard = [d.inspect() for d in self._shards]
+        stats: Dict[str, int] = {}
+        adm_stats: Dict[str, int] = {}
+        for ins in per_shard:
+            for k, v in ins["stats"].items():
+                stats[k] = stats.get(k, 0) + v
+            for k, v in ins["admission"]["stats"].items():
+                adm_stats[k] = adm_stats.get(k, 0) + v
+        rung = max(ins["admission"]["rung"] for ins in per_shard)
+        with self._lock:
+            steal = dict(self._stats)
+            mesh_rows = None if self._mesh_rows is None \
+                else self._mesh_rows.tolist()
+        return {
+            "n_shards": len(self._shards),
+            "ring": self._ring.nodes(),
+            "policy": per_shard[0]["policy"],
+            "servants": sum(len(ins["servants"]) for ins in per_shard),
+            "grants_outstanding": sum(
+                ins["grants_outstanding"] for ins in per_shard),
+            "zombies": sum(ins["zombies"] for ins in per_shard),
+            "pending_requests": sum(
+                ins["pending_requests"] for ins in per_shard),
+            "envs_interned": sum(
+                ins["envs_interned"] for ins in per_shard),
+            "stats": stats,
+            "steal": steal,
+            "admission": {
+                "rung": rung,
+                "rung_name": RUNG_NAMES[rung],
+                "stats": adm_stats,
+            },
+            "latency_breakdown": self.aggregate_latency_breakdown(),
+            "mesh_loads": mesh_rows,
+            "per_shard": per_shard,
+        }
+
+    def aggregate_latency_breakdown(self) -> Dict[str, Dict[str, float]]:
+        """Pooled stage percentiles: every shard's retained samples
+        concatenated per stage (exact over the pooled window — NOT an
+        average of per-shard percentiles, which has no meaning)."""
+        pooled: Dict[str, List[np.ndarray]] = defaultdict(list)
+        counts: Dict[str, int] = defaultdict(int)
+        for d in self._shards:
+            timer = d.stage_timer
+            for stage in list(timer.stages()):
+                s = timer.stage_samples(stage)
+                if s is not None:
+                    pooled[stage].append(s)
+                    counts[stage] += timer.stage_count(stage)
+        out: Dict[str, Dict[str, float]] = {}
+        for stage, chunks in pooled.items():
+            arr = np.concatenate(chunks)
+            out[stage] = {
+                "count": int(counts[stage]),
+                "mean_ms": round(float(arr.mean()) * 1000.0, 4),
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1000.0, 4),
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1000.0, 4),
+            }
+        return out

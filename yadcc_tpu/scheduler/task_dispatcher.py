@@ -115,6 +115,17 @@ class _SnapBuffer:
 
 
 @dataclass
+class LoadSignal:
+    """One shard's load, as the steal path sees it (load_signal())."""
+
+    capacity: int
+    outstanding: int
+    queued_immediate: int
+    utilization: float
+    free: int
+
+
+@dataclass
 class _Pending:
     env_id: int
     env_digest: str
@@ -152,6 +163,8 @@ class TaskDispatcher:
         start_dispatch_thread: bool = True,
         pipeline_depth: int = 0,
         admission_config: Optional[AdmissionConfig] = None,
+        grant_id_start: int = 1,
+        grant_id_stride: int = 1,
     ):
         self._policy = policy
         self._clock = clock
@@ -206,7 +219,17 @@ class TaskDispatcher:
             max_servants, np.int64)  # guarded by: self._lock
 
         self._grants: Dict[int, _Grant] = {}  # guarded by: self._lock
-        self._next_grant_id = 1  # guarded by: self._lock
+        # Sharded control plane (scheduler/shard_router.py): shard k of
+        # N issues ids k+1, k+1+N, k+1+2N, ... — disjoint by
+        # construction, so a grant id alone routes its renewal/free
+        # back to the owning shard and a stolen grant can never
+        # collide with (or be re-issued by) another shard.
+        if not (1 <= grant_id_start <= grant_id_stride):
+            raise ValueError(
+                f"grant_id_start must be in [1, stride]: "
+                f"{grant_id_start=} {grant_id_stride=}")
+        self._next_grant_id = grant_id_start  # guarded by: self._lock
+        self._grant_id_stride = grant_id_stride
 
         self._pending: List[_Pending] = []  # guarded by: self._lock
         self._stopping = False  # guarded by: self._lock
@@ -511,11 +534,16 @@ class TaskDispatcher:
     # ------------------------------------------------------------------
 
     def admission_check(self, immediate: int = 1,
-                        prefetch: int = 0) -> AdmissionDecision:
+                        prefetch: int = 0,
+                        requestor: str = "") -> AdmissionDecision:
         """Rule on one grant request BEFORE it queues.  Called by
         SchedulerService.WaitForStartingTask; cheap enough for the
         grant hot path (one cached-capacity read + a pending-list sum
-        under the lock, ladder bookkeeping under its leaf lock)."""
+        under the lock, ladder bookkeeping under its leaf lock).
+        ``requestor`` exists for surface parity with the shard router
+        (which routes the check to the requestor's home shard); a
+        single dispatcher has one ladder and ignores it."""
+        del requestor
         clock = self._clock
         t0 = clock.now()
         with self._lock:
@@ -524,6 +552,38 @@ class TaskDispatcher:
                                          clock.now())
         self.stage_timer.record("admission", clock.now() - t0)
         return decision
+
+    def load_signal(self) -> "LoadSignal":
+        """The admission load signal, exported for the shard router's
+        steal decision (doc/scheduler.md, "Sharded control plane"):
+        demand = outstanding grants + queued immediate; free capacity
+        is what a donor shard could give away right now.  Same
+        definitions as _utilization_locked — one signal, two consumers
+        (ladder and steal), so they can never disagree about what
+        "overloaded" means."""
+        with self._lock:
+            now = self._clock.now()
+            cap = self._capacity_total_locked(now)
+            outstanding = len(self._grants)
+            queued = sum(r.immediate_left for r in self._pending)
+        util = (outstanding + queued) / cap if cap > 0 else 0.0
+        return LoadSignal(
+            capacity=cap, outstanding=outstanding,
+            queued_immediate=queued, utilization=util,
+            free=max(0, cap - outstanding))
+
+    def pool_load_arrays(self):
+        """(alive, effective_capacity, running) copies for the
+        device-sharded cross-shard load summary
+        (parallel/mesh.py:shard_load_summary_fn).  One O(S) vectorized
+        copy under the lock; callers own the result."""
+        with self._lock:
+            foreign = np.maximum(self._arr_load - self._arr_running, 0)
+            eff = np.minimum(self._arr_cap_rep, self._arr_nprocs - foreign)
+            eff = np.where(self._arr_accepting & self._arr_mem_ok,
+                           np.maximum(eff, 0), 0).astype(np.int32)
+            return (self._arr_alive.copy(), eff,
+                    self._arr_running.copy())
 
     def _utilization_locked(self, now: float) -> Tuple[float, int]:
         """(demand / capacity, capacity).  Demand counts every
@@ -767,7 +827,7 @@ class TaskDispatcher:
             expires_at=now + req.lease_s,
             requestor=req.requestor,
         )
-        self._next_grant_id += 1
+        self._next_grant_id += self._grant_id_stride
         self._grants[g.grant_id] = g
         servant.running_grants.add(g.grant_id)
         self._arr_running[pick] += 1

@@ -42,6 +42,20 @@ BASELINE configs[4]'s heterogeneous-capacity bin-pack.
 
     python -m yadcc_tpu.tools.pod_sim --tasks 100000 --servants 5000 \
         --capacity-dist uniform:4:16
+
+Sharded control plane (`--shards N`, doc/scheduler.md): the dispatcher
+becomes a ShardRouter over N PR-2 dispatchers — servant heartbeats and
+grant requests route by consistent hash, grant demand arrives through a
+pool of synthetic delegate identities (each its own mock channel, so
+the RPC peer — the routing key — is real), and `--hotspot zipf:S`
+skews which delegate asks, concentrating demand on the hot delegates'
+home shards so the cross-shard steal path actually runs.  The JSON
+gains `steal_rate`, per-shard `latency_breakdown`s, and a
+`demand_balance` section (max-shard demand vs mean, sampled ~20Hz).
+`--smoke` is the CI gate (small fleet, hotspot skew, assertions on
+steal engagement, unique grant ids, and aggregate==Σ per-shard).
+`--ab` produces the sharded-vs-single + steal-on/off artifact
+(artifacts/pod_sim_sharded.json; doc/benchmarks.md).
 """
 
 from __future__ import annotations
@@ -94,10 +108,33 @@ def parse_capacity_dist(spec: str, base_capacity: int):
     raise ValueError(f"unknown capacity dist {spec!r}")
 
 
+def parse_hotspot(spec: Optional[str], n_delegates: int):
+    """`--hotspot zipf:S` -> per-call delegate sampler CDF (rank-based
+    Zipf over the delegate pool: P(rank r) ∝ 1/(r+1)^S), or None for
+    uniform demand."""
+    if not spec or spec == "none":
+        return None
+    kind, _, rest = spec.partition(":")
+    if kind != "zipf" or not rest:
+        raise ValueError(f"unknown hotspot spec {spec!r} "
+                         "(expected zipf:<exponent>)")
+    s = float(rest)
+    if s <= 0:
+        raise ValueError(f"zipf exponent must be positive: {spec!r}")
+    w = 1.0 / np.power(np.arange(1, n_delegates + 1, dtype=np.float64), s)
+    return np.cumsum(w / w.sum())
+
+
 class PodSim:
     def __init__(self, servants: int, capacity: int, policy: str,
                  exec_ms: float, churn_per_s: int, seed: int = 7,
-                 pipeline_depth: int = 0, capacity_dist: str = "fixed"):
+                 pipeline_depth: int = 0, capacity_dist: str = "fixed",
+                 shards: int = 1, hotspot: Optional[str] = None,
+                 steal: bool = True, delegates: int = 32,
+                 pumps: Optional[int] = None, hb_interval: float = 0.5,
+                 mesh_loads: str = "auto", check_unique: bool = False,
+                 arrival_rate: float = 0.0, pump_batch: int = 128,
+                 steal_batch: int = 64):
         from ..cache.cache_engine import NullCacheEngine
         from ..cache.in_memory_cache import InMemoryCache
         from ..cache.service import CacheService
@@ -116,23 +153,64 @@ class PodSim:
         self.capacity_dist = capacity_dist
         self._cap_sampler = parse_capacity_dist(capacity_dist, capacity)
         self.env = "c" * 64
-        # ~12% slot headroom over the fleet, rounded to 256 (churn
-        # replaces leavers slot-for-slot, so occupancy stays ~flat);
-        # oversizing the pool just inflates every O(S) policy/snapshot
-        # operation — at 5k servants a power-of-two pool would be 64%
-        # dead slots that every mask and score pass still scans.
-        pool = max(512, (servants * 9 // 8 + 64 + 255) // 256 * 256)
-        pol = make_policy(policy, max_servants=pool, avoid_self=False)
-        # Like scheduler/entry.py: device kernels compile before
-        # serving, never inside a live grant cycle.
-        if pipeline_depth > 0:
-            pol.stream_warmup(pool)
+        self.shards = max(1, shards)
+        self.hotspot = hotspot
+        self.hb_interval = hb_interval
+        # Paced arrivals (tasks/s across all submitters; 0 = flood):
+        # "sustained rate R" is a different claim from "drain a burst as
+        # fast as the box allows", and on a small host the flood's
+        # client CPU writes its own preemption stalls into the
+        # scheduler's stage percentiles.
+        self.arrival_rate = arrival_rate
+        self.pump_batch = max(1, pump_batch)
+        self.router = None
+        if self.shards == 1:
+            # ~12% slot headroom over the fleet, rounded to 256 (churn
+            # replaces leavers slot-for-slot, so occupancy stays ~flat);
+            # oversizing the pool just inflates every O(S)
+            # policy/snapshot operation — at 5k servants a power-of-two
+            # pool would be 64% dead slots that every mask and score
+            # pass still scans.
+            pool = max(512, (servants * 9 // 8 + 64 + 255) // 256 * 256)
+            pol = make_policy(policy, max_servants=pool, avoid_self=False)
+            # Like scheduler/entry.py: device kernels compile before
+            # serving, never inside a live grant cycle.
+            if pipeline_depth > 0:
+                pol.stream_warmup(pool)
+            else:
+                pol.warmup(pool)
+            self.dispatcher = TaskDispatcher(
+                pol, max_servants=pool, batch_window_s=0.001,
+                min_memory_for_new_task=1,
+                pipeline_depth=pipeline_depth)
         else:
-            pol.warmup(pool)
-        self.dispatcher = TaskDispatcher(
-            pol, max_servants=pool, batch_window_s=0.001,
-            min_memory_for_new_task=1,
-            pipeline_depth=pipeline_depth)
+            # Sharded control plane: the same headroom math per shard
+            # (the consistent hash spreads the fleet ~evenly; the
+            # scheduler vnode density bounds the max/min share at
+            # ~1.14x, covered by the 25% headroom + ring slack).
+            from ..scheduler.shard_router import ShardRouter, StealConfig
+
+            per = servants // self.shards
+            pool = max(256, (per * 10 // 8 + 64 + 255) // 256 * 256)
+            policies = [make_policy(policy, max_servants=pool,
+                                    avoid_self=False)
+                        for _ in range(self.shards)]
+            for pol in policies:
+                if pipeline_depth > 0:
+                    pol.stream_warmup(pool)
+                else:
+                    pol.warmup(pool)
+            mesh = self._maybe_mesh(mesh_loads)
+            self.router = ShardRouter.build(
+                lambda k: policies[k], self.shards,
+                max_servants_per_shard=pool,
+                steal=StealConfig(enabled=steal,
+                                  max_batch=max(1, steal_batch)),
+                mesh=mesh,
+                batch_window_s=0.001,
+                min_memory_for_new_task=1,
+                pipeline_depth=pipeline_depth)
+            self.dispatcher = self.router
         self.bookkeeper = RunningTaskBookkeeper()
         self.cache = CacheService(InMemoryCache(256 << 20),
                                   NullCacheEngine())
@@ -145,6 +223,29 @@ class PodSim:
         register_mock_server(self._mock_name, self.service.spec())
         self.sched_channel = Channel(
             f"mock://{self._mock_name}@10.255.0.1:9")
+        # Synthetic delegate identities: each its own channel so the
+        # observed RPC peer — the router's consistent-hash routing key
+        # — is a real, distinct delegate address (servants live in
+        # 10.0/16; delegates in 10.254/16).
+        self.n_delegates = max(1, delegates)
+        self.n_pumps = pumps if pumps else max(1, self.shards)
+        self.delegate_channels = [
+            Channel(f"mock://{self._mock_name}"
+                    f"@10.254.{d >> 8 & 255}.{d & 255}:7")
+            for d in range(self.n_delegates)
+        ]
+        self._hotspot_cdf = parse_hotspot(hotspot, self.n_delegates)
+        # Unique-grant-id oracle (the stolen-grant never-double-issued
+        # invariant): smoke/test rigs flip check_unique on; production-
+        # scale runs skip the per-grant set cost.
+        self._check_unique = check_unique
+        self._seen_gids: set = set()
+        self._dup_gids = 0
+        self._gid_lock = threading.Lock()
+        # Per-shard demand-balance samples ((outstanding + queued) per
+        # shard, ~20Hz) — the hotspot A/B's headline series.
+        self._demand_samples: List[np.ndarray] = []
+        self._backlog_samples: List[int] = []
         # Client-observed stages (grant_call total + derived transport).
         self.client_timer = StageTimer(maxlen=16384)
 
@@ -164,6 +265,7 @@ class PodSim:
         self.running: Dict[str, _Completion] = {}
         self.run_lock = threading.Lock()
         self.grants: "queue.Queue[Tuple[int, str]]" = queue.Queue()
+        self.bind_q: "queue.Queue[_Completion]" = queue.Queue()
         self.need = 0                # tasks waiting for a grant
         self.need_lock = threading.Lock()
         self.events: List[Tuple[float, int, _Completion]] = []
@@ -176,7 +278,25 @@ class PodSim:
         self.grant_lat_ms: List[float] = []
         self.grant_calls = 0
         self.grants_granted = 0
+        self.grants_stolen = 0
         self._stop = threading.Event()
+
+    def _maybe_mesh(self, mesh_loads: str):
+        """Device mesh for the cross-shard load summary: 'off' | 'auto'
+        (one device per shard when the backend has enough; pod_sim's
+        main() forces host devices for the sharded runs)."""
+        if mesh_loads == "off":
+            return None
+        try:
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            if len(jax.devices()) < self.shards:
+                return None
+            return make_mesh(self.shards)
+        except Exception:
+            return None
 
     # -- fleet ---------------------------------------------------------------
 
@@ -228,11 +348,29 @@ class PodSim:
                 self._hb_nonempty.discard(loc)
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.wait(0.5):
+        # `--hb-interval` paces the whole-fleet beat cycle: at 50k
+        # servants a 0.5s cadence would spend a third of a core
+        # re-beating an unchanged fleet (leases are 10s — a 2s cadence
+        # is still 5x margin).  Beats are PHASE-SPREAD across the
+        # interval in 256-servant chunks, matching production (every
+        # servant runs its own pacemaker; 50k of them do not arrive as
+        # one phase-locked burst) — the monolithic pass was a ~250ms
+        # CPU burst whose GIL convoys landed in the co-hosted
+        # dispatchers' stage percentiles.
+        while not self._stop.is_set():
             with self.fleet_lock:
                 locs = list(self.servant_running)
-            for loc in locs:
-                self._heartbeat_one(loc)
+            if not locs:
+                if self._stop.wait(self.hb_interval):
+                    return
+                continue
+            chunk = 256
+            pause = self.hb_interval * chunk / len(locs)
+            for i in range(0, len(locs), chunk):
+                for loc in locs[i:i + chunk]:
+                    self._heartbeat_one(loc)
+                if self._stop.wait(min(pause, 1.0)):
+                    return
             self.dispatcher.on_expiration_timer()
 
     def _churn_loop(self) -> None:
@@ -264,38 +402,65 @@ class PodSim:
 
     # -- scheduler interaction ----------------------------------------------
 
+    def _pick_delegate(self, rng) -> int:
+        """Which synthetic delegate asks next: Zipf-skewed under
+        --hotspot (demand concentrates on the hot delegates' home
+        shards), uniform otherwise.  Each pump passes its own
+        random.Random — the shared numpy Generator is not thread-safe
+        and must not be hit from every fetcher."""
+        if self._hotspot_cdf is None:
+            return rng.randrange(self.n_delegates)
+        return int(np.searchsorted(self._hotspot_cdf, rng.random()))
+
     def _grant_pump(self) -> None:
-        """TaskGrantKeeper analogue: one fetcher per compiler env,
-        batching `immediate` to the current number of waiters.
+        """TaskGrantKeeper analogue: a fetcher batching `immediate` to
+        the current number of waiters.  `--pumps` of these run
+        concurrently (one is the PR-2 behavior); each call RESERVES its
+        demand so two pumps never double-fetch for the same waiters,
+        and returns the unserved remainder.
 
         Calls ride the production RPC path (WaitForStartingTask handler
-        + message/frame codec); `transport` is the client-observed wall
-        minus the server-side inner time, which the in-process mock
-        transport makes exact (rpc.transport.last_server_inner_s)."""
+        + message/frame codec) through a per-delegate channel, so the
+        observed peer — the shard router's routing key — is a real
+        delegate address; `transport` is the client-observed wall minus
+        the server-side inner time, which the in-process mock transport
+        makes exact (rpc.transport.last_server_inner_s)."""
+        import random
+
         from .. import api
         from ..rpc import RpcError
         from ..rpc import transport as rpc_transport
 
+        rng = random.Random(threading.get_ident() ^ id(self))
         while not self._stop.is_set():
             with self.need_lock:
-                n = self.need
+                n = min(self.need, self.pump_batch)
+                if n > 0:
+                    self.need -= n          # reserve
             if n <= 0:
                 time.sleep(0.0005)
                 continue
-            n = min(n, 128)
+            chan = self.delegate_channels[self._pick_delegate(rng)]
+            # Short in-scheduler wait (reference task_grant_keeper
+            # polls on a demand window): a saturated shard then returns
+            # its PARTIAL grant batch quickly instead of parking the
+            # whole free capacity inside the pending request for the
+            # full wait — grants must circulate back to the client to
+            # run, complete, and free, or the request starves itself.
             req = api.scheduler.WaitForStartingTaskRequest(
                 token="", immediate_reqs=n,
-                milliseconds_to_wait=5000, next_keep_alive_in_ms=15000)
+                milliseconds_to_wait=250, next_keep_alive_in_ms=15000)
             req.env_desc.compiler_digest = self.env
             t0 = time.perf_counter()
             try:
-                resp, _ = self.sched_channel.call(
+                resp, _ = chan.call(
                     "ytpu.SchedulerService", "WaitForStartingTask", req,
                     api.scheduler.WaitForStartingTaskResponse)
                 got = [(g.task_grant_id, g.servant_location)
                        for g in resp.grants]
+                stolen = int(resp.stolen_grants)
             except RpcError:
-                got = []  # NO_QUOTA (timeout without capacity)
+                got, stolen = [], 0  # NO_QUOTA (timeout w/o capacity)
             total = time.perf_counter() - t0
             self.grant_lat_ms.append(total * 1000.0)
             self.client_timer.record("grant_call", total)
@@ -303,62 +468,149 @@ class PodSim:
             if inner is not None:
                 self.client_timer.record(
                     "transport", max(0.0, total - inner))
-            self.grant_calls += 1
-            self.grants_granted += len(got)
-            if not got:
-                continue
             with self.need_lock:
-                self.need -= len(got)
+                self.need += n - len(got)   # return unserved demand
+                self.grant_calls += 1
+                self.grants_granted += len(got)
+                self.grants_stolen += stolen
+            if self._check_unique and got:
+                with self._gid_lock:
+                    for gid, _ in got:
+                        if gid in self._seen_gids:
+                            self._dup_gids += 1
+                        self._seen_gids.add(gid)
             for g in got:
                 self.grants.put(g)
 
+    def _demand_monitor(self) -> None:
+        """~20Hz per-shard demand sampler (outstanding grants + queued
+        immediate — the admission signal's numerator).  The hotspot
+        A/B's claim lives here: with stealing the max-shard demand
+        stays within ~2x the mean; without it the hot shard's backlog
+        grows unbounded while its neighbours idle."""
+        interval = 0.05 if self._hotspot_cdf is not None else 0.25
+        while not self._stop.wait(interval):
+            loads = [d.load_signal() for d in self.router.shards]
+            self._demand_samples.append(np.array(
+                [s.outstanding + s.queued_immediate for s in loads],
+                np.int64))
+            # Client-side backlog (tasks holding demand but not yet
+            # bound to a grant): the part of "unbounded growth" the
+            # scheduler-side queues — bounded by pump concurrency —
+            # cannot show.
+            self._backlog_samples.append(self.bind_q.qsize())
+
+    def demand_balance(self) -> Optional[dict]:
+        """Summary of the per-shard demand series: for each sample with
+        any demand, max/mean across shards; reported as p50/p95 plus
+        the peak absolute max-shard demand."""
+        if not self._demand_samples:
+            return None
+        m = np.stack(self._demand_samples)        # [T, n_shards]
+        totals = m.sum(axis=1)
+        live = m[totals > 0]
+        if live.size == 0:
+            return None
+        ratios = live.max(axis=1) / np.maximum(live.mean(axis=1), 1e-9)
+        backlog = np.asarray(self._backlog_samples, np.int64) \
+            if self._backlog_samples else np.zeros(1, np.int64)
+        return {
+            "samples": int(m.shape[0]),
+            "live_samples": int(live.shape[0]),
+            "max_over_mean_p50": round(float(np.percentile(ratios, 50)), 2),
+            "max_over_mean_p95": round(float(np.percentile(ratios, 95)), 2),
+            "peak_max_shard_demand": int(live.max()),
+            "peak_mean_demand": round(float(live.mean(axis=1).max()), 1),
+            # Ungranted client demand over time: flat/draining when the
+            # plane keeps up, linear growth when a hot shard is
+            # starving demand it cannot serve and will not steal for.
+            "client_backlog_p50": int(np.percentile(backlog, 50)),
+            "client_backlog_peak": int(backlog.max()),
+        }
+
     def _dispatch(self, comp: _Completion) -> None:
-        """Acquire a grant for `comp` and schedule its completion."""
+        """Register demand for `comp`; the binder marries it to a grant
+        when one lands.  Submitters do NOT block per task — that design
+        needed one thread per in-flight task to keep the pump's batches
+        full, and on a small host the resulting thread herd wrote its
+        own preemption stalls into the dispatch-stage percentiles."""
         with self.need_lock:
             self.need += 1
-        gid, loc = self.grants.get()
-        comp.grant_id, comp.location = gid, loc
-        with self.fleet_lock:
-            srv = self.servant_running.get(loc)
-            if srv is not None:
-                srv[gid] = comp.digest
-        dt = float(self.rng.exponential(self.exec_ms)) / 1000.0
-        with self.ev_cv:
-            self._seq += 1
-            heapq.heappush(self.events,
-                           (time.monotonic() + dt, self._seq, comp))
-            self.ev_cv.notify()
+        self.bind_q.put(comp)
+
+    def _binder_loop(self) -> None:
+        """Marry arriving grants to pending tasks (the delegate's
+        grant-pool consumer) and schedule their completions."""
+        import random
+
+        while not self._stop.is_set():
+            try:
+                gid, loc = self.grants.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            # A grant is only fetched against reserved demand, so a
+            # pending task always exists (or arrives immediately).
+            comp = self.bind_q.get()
+            comp.grant_id, comp.location = gid, loc
+            with self.fleet_lock:
+                srv = self.servant_running.get(loc)
+                if srv is not None:
+                    srv[gid] = comp.digest
+            dt = random.expovariate(1000.0 / self.exec_ms) \
+                if self.exec_ms > 0 else 0.0
+            with self.ev_cv:
+                self._seq += 1
+                heapq.heappush(self.events,
+                               (time.monotonic() + dt, self._seq, comp))
+                self.ev_cv.notify()
 
     def _completion_loop(self) -> None:
         from .. import api
         from ..rpc import RpcContext
 
         while not self._stop.is_set():
+            batch: List[_Completion] = []
             with self.ev_cv:
                 while not self.events and not self._stop.is_set():
                     self.ev_cv.wait(0.2)
                 if self._stop.is_set():
                     return
-                due, _, comp = self.events[0]
+                # Drain due events in small passes (16): the grant
+                # frees amortize into ONE FreeTask batch — at a 1M-task
+                # run the per-completion lock round-trip was a
+                # first-order cost — while the pass stays a sub-ms GIL
+                # hold so it cannot smear the dispatch-stage
+                # percentiles it shares the core with.
                 now = time.monotonic()
-                if due > now:
+                while self.events and len(batch) < 16:
+                    due, _, comp = self.events[0]
+                    if due > now:
+                        break
+                    heapq.heappop(self.events)
+                    batch.append(comp)
+                if not batch:
+                    due = self.events[0][0]
                     self.ev_cv.wait(min(due - now, 0.2))
                     continue
-                heapq.heappop(self.events)
             # "Compile" finished: fill the cache (real PutEntry with the
-            # servant token path), free the grant, wake joiners.
-            key = f"ytpu-cxx2-entry-{comp.digest}"
-            req = api.cache.PutEntryRequest(token="", key=key)
-            ctx = RpcContext(peer=comp.location)
-            self.cache.PutEntry(req, b"OBJ" + comp.digest.encode(), ctx)
-            self.dispatcher.free_task([comp.grant_id])
+            # servant token path), free the grants, wake joiners.
+            for comp in batch:
+                key = f"ytpu-cxx2-entry-{comp.digest}"
+                req = api.cache.PutEntryRequest(token="", key=key)
+                ctx = RpcContext(peer=comp.location)
+                self.cache.PutEntry(req, b"OBJ" + comp.digest.encode(),
+                                    ctx)
+            self.dispatcher.free_task([c.grant_id for c in batch])
             with self.fleet_lock:
-                srv = self.servant_running.get(comp.location)
-                if srv is not None:
-                    srv.pop(comp.grant_id, None)
+                for comp in batch:
+                    srv = self.servant_running.get(comp.location)
+                    if srv is not None:
+                        srv.pop(comp.grant_id, None)
             with self.run_lock:
-                self.running.pop(comp.digest, None)
-            comp.done.set()
+                for comp in batch:
+                    self.running.pop(comp.digest, None)
+            for comp in batch:
+                comp.done.set()
 
     # -- client side ---------------------------------------------------------
 
@@ -439,12 +691,17 @@ class PodSim:
         self.rng.shuffle(picks)
 
         self._sync_replica()
+        loops = [(self._heartbeat_loop, "hb"),
+                 (self._churn_loop, "churn"),
+                 (self._completion_loop, "complete"),
+                 (self._binder_loop, "binder"),
+                 (self._replica_loop, "bloom")]
+        loops += [(self._grant_pump, f"grants-{i}")
+                  for i in range(self.n_pumps)]
+        if self.router is not None:
+            loops.append((self._demand_monitor, "demand"))
         threads = [threading.Thread(target=f, daemon=True, name=n)
-                   for f, n in [(self._heartbeat_loop, "hb"),
-                                (self._churn_loop, "churn"),
-                                (self._completion_loop, "complete"),
-                                (self._replica_loop, "bloom"),
-                                (self._grant_pump, "grants")]]
+                   for f, n in loops]
         work = queue.Queue()
         for p in picks:
             work.put(sources[p])
@@ -453,12 +710,21 @@ class PodSim:
 
         def submitter():
             pending = []
+            share = (self.arrival_rate / submitters
+                     if self.arrival_rate > 0 else 0.0)
+            t_start = time.monotonic()
+            n_done = 0
             while True:
                 try:
                     digest = work.get_nowait()
                 except queue.Empty:
                     break
                 self.submit(digest)
+                n_done += 1
+                if share > 0 and n_done % 32 == 0:
+                    ahead = t_start + n_done / share - time.monotonic()
+                    if ahead > 0:
+                        time.sleep(ahead)
                 with self.run_lock:
                     c = self.running.get(digest)
                 if c is not None:
@@ -506,6 +772,40 @@ class PodSim:
         dispatch_cycle = disp_lat.get("dispatch_cycle")
         with self.fleet_lock:
             caps = np.array(list(self.servant_caps.values()), np.int64)
+        # Sharded-plane extras: steal accounting, per-shard stage
+        # breakdowns, and the demand-balance series (doc/benchmarks.md
+        # "pod_sim fields").
+        sharded: dict = {}
+        if self.router is not None:
+            per_shard = []
+            shard_cycle_p99 = []
+            for k, ins in enumerate(disp["per_shard"]):
+                lb = ins["latency_breakdown"]
+                cyc = lb.get("dispatch_cycle")
+                if cyc:
+                    shard_cycle_p99.append(cyc["p99_ms"])
+                per_shard.append({
+                    "shard": k,
+                    "servants": len(ins["servants"]),
+                    "granted": ins["stats"]["granted"],
+                    "grants_outstanding": ins["grants_outstanding"],
+                    "latency_breakdown": lb,
+                })
+            sharded = {
+                "shards": self.shards,
+                "hotspot": self.hotspot or "none",
+                "steal": disp["steal"],
+                "steal_rate": round(
+                    self.grants_stolen / max(1, self.grants_granted), 4),
+                "duplicate_grant_ids": (
+                    self._dup_gids if self._check_unique else None),
+                "dispatch_only_p99_ms_max_shard": (
+                    round(max(shard_cycle_p99), 4)
+                    if shard_cycle_p99 else None),
+                "demand_balance": self.demand_balance(),
+                "mesh_loads": disp.get("mesh_loads"),
+                "per_shard": per_shard,
+            }
         return {
             "tasks": int(done),
             "servants": len(self.servant_running),
@@ -518,11 +818,17 @@ class PodSim:
             "churn_per_s": self.churn_per_s,
             "wall_seconds": round(wall, 2),
             "tasks_per_sec": round(done / wall, 1),
+            # The control-plane headline: grants issued per second
+            # through the full RPC grant path (the A/B axis of
+            # artifacts/pod_sim_sharded.json).
+            "assignments_per_sec": round(self.grants_granted / wall, 1),
             "breakdown": {k: int(self.stats[k]) for k in
                           ("hit_cache", "reused", "actually_run",
                            "retries", "servants_churned")},
             "grant_calls": int(self.grant_calls),
             "grants_granted": int(self.grants_granted),
+            "grants_stolen": int(self.grants_stolen),
+            "sharded": sharded or None,
             "grant_call_p50_ms": round(float(np.percentile(lat, 50)), 2),
             "grant_call_p99_ms": round(float(np.percentile(lat, 99)), 2),
             # Per-stage decomposition of the grant path (each entry:
@@ -556,24 +862,237 @@ class PodSim:
         }
 
 
-def main() -> None:
+def run_one(args, *, shards: int, hotspot: Optional[str], steal: bool,
+            tasks: int, check_unique: bool = False) -> dict:
+    sim = PodSim(args.servants, args.capacity, args.policy,
+                 args.exec_ms, args.churn_per_s,
+                 pipeline_depth=args.pipeline_depth,
+                 capacity_dist=args.capacity_dist,
+                 shards=shards, hotspot=hotspot, steal=steal,
+                 delegates=args.delegates, pumps=args.pumps,
+                 hb_interval=args.hb_interval,
+                 mesh_loads=args.mesh_loads,
+                 check_unique=check_unique,
+                 arrival_rate=args.arrival_rate,
+                 pump_batch=args.pump_batch,
+                 steal_batch=args.steal_batch)
+    return sim.run(tasks, args.dup_rate, args.submitters)
+
+
+def smoke(args) -> int:
+    """CI gate (tools/ci.sh: `pod_sim --shards 4 --smoke`): a small
+    hotspot-skewed sharded run asserting the sharded plane's
+    invariants — steal engages, no grant id is ever double-issued,
+    aggregate counters == Σ per-shard, and nothing is lost."""
+    args.servants = min(args.servants, 96)
+    args.capacity = 2
+    args.capacity_dist = "fixed"
+    args.exec_ms = 40.0
+    args.churn_per_s = 0
+    args.policy = "greedy_cpu"
+    args.dup_rate = 0.2
+    args.submitters = 6
+    out = run_one(args, shards=max(2, args.shards),
+                  hotspot=args.hotspot or "zipf:1.5", steal=True,
+                  tasks=1500, check_unique=True)
+    sh = out["sharded"]
+    b = out["breakdown"]
+    failures = []
+    if out["tasks"] != 1500:
+        failures.append(f"lost tasks: {out['tasks']}/1500")
+    if b["hit_cache"] + b["reused"] + b["actually_run"] != 1500:
+        failures.append("outcome ladder does not sum")
+    if sh["duplicate_grant_ids"] != 0:
+        failures.append(
+            f"DOUBLE-ISSUED grant ids: {sh['duplicate_grant_ids']}")
+    if sh["steal"]["stolen_grants"] <= 0:
+        failures.append("steal path never engaged under hotspot skew")
+    if out["grants_granted"] != out["scheduler_stats"]["granted"]:
+        failures.append("aggregate granted != client-observed grants")
+    per_shard_granted = sum(p["granted"] for p in sh["per_shard"])
+    if per_shard_granted != out["scheduler_stats"]["granted"]:
+        failures.append("aggregate stats != Σ per-shard stats")
+    print(json.dumps({
+        "smoke": "pod_sim_sharded",
+        "shards": sh["shards"],
+        "hotspot": sh["hotspot"],
+        "tasks": out["tasks"],
+        "assignments_per_sec": out["assignments_per_sec"],
+        "steal_rate": sh["steal_rate"],
+        "stolen_grants": sh["steal"]["stolen_grants"],
+        "duplicate_grant_ids": sh["duplicate_grant_ids"],
+        "failures": failures,
+    }, indent=2))
+    return 1 if failures else 0
+
+
+def run_ab(args) -> dict:
+    """The sharded-vs-single + steal-on/off artifact
+    (artifacts/pod_sim_sharded.json; doc/benchmarks.md "Sharded
+    control plane").  Four sections:
+
+    1. `sharded` — the throughput run (flood arrivals, deep batches):
+       assignments/s vs the committed single-dispatcher baseline
+       (artifacts/pod_sim_100k.json, same machine class).
+    2. `sharded_latency` + `single_50k_control` — the latency pair:
+       the SAME 50k fleet at the baseline artifact's task pressure
+       (~2.9k/s), sharded vs one dispatcher, so the per-shard
+       dispatch-cycle cost is compared apples-to-apples at scale.
+    3. `hotspot_ab` — the same Zipf-skewed workload twice, stealing on
+       and off, on a deliberately overloadable fleet.
+
+    Throughput and unpolluted latency are measured in separate runs on
+    purpose: the sim co-hosts scheduler and clients in one process, so
+    a flood's client CPU dilates every stage percentile it shares the
+    core with (see --switch-interval)."""
     import os
     import sys
 
-    # Same CPU priority a production scheduler daemon runs at (and
-    # bench.py uses): on a small shared host, background work must not
-    # write its own pauses into the stage percentiles.
+    base_path = os.path.join(os.path.dirname(__file__), "..", "..",
+                             "artifacts", "pod_sim_100k.json")
+    baseline = None
     try:
-        os.setpriority(os.PRIO_PROCESS, 0, -10)
-    except (OSError, AttributeError):
+        with open(base_path) as f:
+            b = json.load(f)
+        baseline = {
+            "source": "artifacts/pod_sim_100k.json",
+            "servants": b["servants"],
+            "tasks": b["tasks"],
+            "assignments_per_sec": round(
+                b["grants_granted"] / b["wall_seconds"], 1),
+            "tasks_per_sec": b["tasks_per_sec"],
+            "dispatch_only_p99_ms": b["dispatch_only_p99_ms"],
+            "grant_call_p99_ms": b["grant_call_p99_ms"],
+        }
+    except (OSError, KeyError, ValueError):
         pass
-    # The sim co-hosts the scheduler with its own virtual build clients
-    # and fleet threads; in production those are REMOTE processes that
-    # never share the scheduler's cores.  The default 5ms GIL switch
-    # interval lets one client burst sit inside a dispatch-cycle
-    # measurement for 5ms on a small host — bound the slice so thread
-    # interleaving noise stays out of the stage percentiles.
+
+    # Best-of-2 (the repo's bench convention — bloom_bench is
+    # best-of-3): on a 1-core co-hosted rig, run-to-run thread
+    # scheduling moves whole-run throughput by ±15%; both runs are
+    # recorded.
     sys.setswitchinterval(0.001)
+    runs = []
+    for i in range(2):
+        print(f"== sharded throughput run {i + 1}/2: {args.shards} "
+              f"shards, {args.servants} servants, {args.tasks} "
+              f"tasks ==", flush=True)
+        runs.append(run_one(args, shards=args.shards, hotspot=None,
+                            steal=True, tasks=args.tasks))
+    sharded = max(runs, key=lambda r: r["assignments_per_sec"])
+
+    # Latency pair: baseline-artifact pressure (~2.9k tasks/s), same
+    # 50k fleet, quieter rig (few threads, coarse GIL slice) so the
+    # stage percentiles price the scheduler, not its co-tenants.
+    lat = argparse.Namespace(**vars(args))
+    lat.submitters = 2
+    lat.pumps = 1
+    lat.pump_batch = 32
+    lat.hb_interval = max(args.hb_interval, 3.0)
+    lat.arrival_rate = baseline["tasks_per_sec"] if baseline else 2900.0
+    lat_tasks = min(args.tasks, 60000)
+    sys.setswitchinterval(0.002)
+    print(f"== latency pair at {lat.arrival_rate:.0f} tasks/s: "
+          f"{args.shards} shards ==", flush=True)
+    sharded_lat = run_one(lat, shards=args.shards, hotspot=None,
+                          steal=True, tasks=lat_tasks)
+    print("== latency pair: single dispatcher, same fleet ==",
+          flush=True)
+    single_lat = run_one(lat, shards=1, hotspot=None, steal=True,
+                         tasks=lat_tasks)
+    sys.setswitchinterval(0.001)
+
+    # Hotspot A/B: a deliberately overloadable fleet (small capacity,
+    # long execution) with Zipf-skewed demand, stealing on vs off.
+    hs = argparse.Namespace(**vars(args))
+    hs.servants = max(args.shards * 64, 256)
+    hs.capacity = 2
+    hs.capacity_dist = "fixed"
+    hs.exec_ms = 120.0
+    hs.churn_per_s = 0
+    hs.submitters = 4
+    hs.dup_rate = 0.0
+    # Flood arrivals: the contrast is sharpest at saturation, where
+    # placement is capacity-bound — the stealing plane spreads the hot
+    # delegates' demand across every shard's servants (max/mean demand
+    # near 1, backlog drains at the whole fleet's rate) while the
+    # no-steal plane grinds at its hot shards' capacity with the rest
+    # of the fleet idle.
+    hs.arrival_rate = 0.0
+    hs.pump_batch = 32
+    hs.steal_batch = 128
+    hotspot = args.hotspot or "zipf:1.4"
+    hs_tasks = min(args.tasks, 20000)
+    print(f"== hotspot A/B ({hotspot}): steal ON ==", flush=True)
+    steal_on = run_one(hs, shards=args.shards, hotspot=hotspot,
+                       steal=True, tasks=hs_tasks, check_unique=True)
+    print(f"== hotspot A/B ({hotspot}): steal OFF ==", flush=True)
+    steal_off = run_one(hs, shards=args.shards, hotspot=hotspot,
+                        steal=False, tasks=hs_tasks)
+
+    def cyc(run, key):
+        c = run["latency_breakdown"].get("dispatch_cycle_ms")
+        return c and c.get(key)
+
+    speedup = None
+    if baseline:
+        speedup = round(sharded["assignments_per_sec"]
+                        / baseline["assignments_per_sec"], 2)
+    return {
+        "metric": "pod_sim_sharded_ab",
+        "single_dispatcher_baseline": baseline,
+        "sharded": sharded,
+        "sharded_throughput_runs": [
+            r["assignments_per_sec"] for r in runs],
+        "sharded_vs_single_assignments_speedup": speedup,
+        "latency_pair": {
+            "arrival_rate": lat.arrival_rate,
+            "tasks": lat_tasks,
+            "sharded_dispatch_cycle_p50_ms": cyc(sharded_lat, "p50_ms"),
+            "sharded_dispatch_cycle_p99_ms_max_shard":
+                sharded_lat["sharded"]["dispatch_only_p99_ms_max_shard"],
+            "single_dispatch_cycle_p50_ms": cyc(single_lat, "p50_ms"),
+            "single_dispatch_cycle_p99_ms":
+                single_lat["dispatch_only_p99_ms"],
+            "sharded": sharded_lat,
+            "single_50k_control": single_lat,
+        },
+        "hotspot_ab": {
+            "hotspot": hotspot,
+            "tasks": hs_tasks,
+            "steal_on": steal_on,
+            "steal_off": steal_off,
+            "max_over_mean_p95_steal_on": (
+                steal_on["sharded"]["demand_balance"] or {}
+            ).get("max_over_mean_p95"),
+            "max_over_mean_p95_steal_off": (
+                steal_off["sharded"]["demand_balance"] or {}
+            ).get("max_over_mean_p95"),
+        },
+        "_meta": {
+            "rig": "single-core co-hosted process: scheduler shards, "
+                   "virtual fleet, and build clients share one GIL; "
+                   "the throughput run's stage p99s are dilated by "
+                   "client CPU (see doc/benchmarks.md), hence the "
+                   "separate baseline-pressure latency pair",
+        },
+    }
+
+
+def quick_sharded_assignments_per_sec() -> float:
+    """bench.py harness v8 canary: grants/s through a small 4-shard
+    router (hotspot-free, steal armed) on the full RPC grant path."""
+    ap = build_arg_parser()
+    args = ap.parse_args([
+        "--servants", "256", "--capacity", "8", "--policy", "greedy_cpu",
+        "--exec-ms", "4", "--churn-per-s", "0", "--dup-rate", "0.2",
+        "--submitters", "8", "--shards", "4", "--hb-interval", "0.5",
+    ])
+    out = run_one(args, shards=4, hotspot=None, steal=True, tasks=6000)
+    return float(out["assignments_per_sec"])
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser("ytpu-pod-sim")
     ap.add_argument("--tasks", type=int, default=50000)
     ap.add_argument("--servants", type=int, default=512)
@@ -588,13 +1107,108 @@ def main() -> None:
                     help="per-servant capacity distribution: fixed | "
                          "uniform:LO:HI | bimodal:A:B:FRAC "
                          "(BASELINE configs[4] heterogeneous bin-pack)")
-    args = ap.parse_args()
-    sim = PodSim(args.servants, args.capacity, args.policy,
-                 args.exec_ms, args.churn_per_s,
-                 pipeline_depth=args.pipeline_depth,
-                 capacity_dist=args.capacity_dist)
-    print(json.dumps(sim.run(args.tasks, args.dup_rate,
-                             args.submitters), indent=2))
+    ap.add_argument("--shards", type=int, default=1,
+                    help="scheduler control-plane shards "
+                         "(doc/scheduler.md \"Sharded control plane\")")
+    ap.add_argument("--hotspot", default=None,
+                    help="arrival skew over the synthetic delegates: "
+                         "zipf:<exponent> (concentrates demand on the "
+                         "hot delegates' home shards, exercising the "
+                         "cross-shard steal path)")
+    ap.add_argument("--no-steal", action="store_true",
+                    help="disable cross-shard work stealing (the "
+                         "hotspot A/B's control arm)")
+    ap.add_argument("--delegates", type=int, default=32,
+                    help="synthetic delegate identities (each a "
+                         "distinct RPC peer = routing key)")
+    ap.add_argument("--pumps", type=int, default=None,
+                    help="concurrent grant fetchers (default: one per "
+                         "shard)")
+    ap.add_argument("--hb-interval", type=float, default=0.5,
+                    help="whole-fleet heartbeat sweep period, seconds")
+    ap.add_argument("--switch-interval", type=float, default=0.001,
+                    help="sys.setswitchinterval for the rig (see main; "
+                         "0.005 for latency-focused runs)")
+    ap.add_argument("--arrival-rate", type=float, default=0.0,
+                    help="paced task arrivals/s across submitters "
+                         "(0 = flood as fast as the box allows)")
+    ap.add_argument("--pump-batch", type=int, default=128,
+                    help="max grants requested per WaitForStartingTask "
+                         "call")
+    ap.add_argument("--steal-batch", type=int, default=64,
+                    help="max grants per cross-shard steal op "
+                         "(StealConfig.max_batch)")
+    ap.add_argument("--mesh-loads", default="auto",
+                    choices=["auto", "off"],
+                    help="device-sharded cross-shard load summary "
+                         "(parallel/mesh.py:shard_load_summary_fn)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: small sharded hotspot run with "
+                         "invariant assertions (exit 1 on violation)")
+    ap.add_argument("--ab", action="store_true",
+                    help="produce the sharded-vs-single + steal-on/off "
+                         "A/B artifact")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON here as well as stdout")
+    return ap
+
+
+def main() -> None:
+    import os
+    import sys
+
+    # Same CPU priority a production scheduler daemon runs at (and
+    # bench.py uses): on a small shared host, background work must not
+    # write its own pauses into the stage percentiles.
+    try:
+        os.setpriority(os.PRIO_PROCESS, 0, -10)
+    except (OSError, AttributeError):
+        pass
+    # Per-event INFO logging (one "cache fill" line per completed
+    # task) is measurement noise at 1M tasks — a million formatted
+    # stderr writes land straight in the stage percentiles.  The env
+    # default must land BEFORE the first get_logger() configures the
+    # root logger (utils/logging.py); the setLevel covers the
+    # already-configured case.
+    import logging
+
+    os.environ.setdefault("YTPU_LOG_LEVEL", "WARNING")
+    logging.getLogger().setLevel(logging.WARNING)
+    args = build_arg_parser().parse_args()
+    # The sim co-hosts the scheduler with its own virtual build clients
+    # and fleet threads; in production those are REMOTE processes that
+    # never share the scheduler's cores.  The GIL switch interval
+    # trades the two measurement artifacts a 1-core co-hosted rig can
+    # have: a SMALL slice preempts mid-stage (a sub-ms dispatch stage
+    # reads as many ms of other threads' time), a LARGE slice delays
+    # stage STARTS (queue-wait and grant-call tails grow).  The PR-2
+    # default (1ms) favors call latency; latency-focused sharded runs
+    # pass --switch-interval 0.005 so a dispatch stage, once entered,
+    # usually runs to completion and the dispatch-only percentiles
+    # price the scheduler, not its co-tenants.
+    sys.setswitchinterval(args.switch_interval)
+    # The device-sharded load summary wants one (virtual) device per
+    # shard; on a CPU host that is free, but the flag must land before
+    # the first jax import.
+    if args.shards > 1 and "jax" not in sys.modules \
+            and args.mesh_loads != "off":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.shards}").strip()
+    if args.smoke:
+        sys.exit(smoke(args))
+    if args.ab:
+        out = run_ab(args)
+    else:
+        out = run_one(args, shards=args.shards, hotspot=args.hotspot,
+                      steal=not args.no_steal, tasks=args.tasks)
+    text = json.dumps(out, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
 
 
 if __name__ == "__main__":

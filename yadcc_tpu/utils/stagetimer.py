@@ -65,6 +65,17 @@ class StageTimer:
                 r.n = r.count = 0
                 r.total = 0.0
 
+    def stages(self) -> list:
+        """Stage names that have recorded at least one sample."""
+        with self._lock:
+            return [s for s, r in self._stages.items() if r.n > 0]
+
+    def stage_count(self, stage: str) -> int:
+        """Lifetime sample count for one stage (0 when unknown)."""
+        with self._lock:
+            r = self._stages.get(stage)
+            return 0 if r is None else r.count
+
     def stage_samples(self, stage: str) -> Optional[np.ndarray]:
         """The retained samples for one stage (seconds), oldest-first
         not guaranteed; None when the stage never recorded."""
