@@ -305,6 +305,141 @@ class TestStealing:
             router.stop()
 
 
+class TestHeartbeatReconcilesPerGrant:
+    def test_remapped_servant_keeps_in_flight_grants(self):
+        """REVIEW fix: notify_servant_running_tasks must judge each
+        grant on its OWNING shard (shard_of_grant), not the servant's
+        current ring shard — after ring_leave remaps a servant, its
+        report would otherwise land on a dispatcher that never knew
+        the grants and kill ALL of them, breaking ring_leave's
+        outstanding-grants-stay-renewable contract."""
+        router = _mk_router(4)
+        try:
+            loc = _servant_keys(1)[0]
+            owner = router.shard_for_location(loc)
+            assert router.keep_servant_alive(_info(loc, 4), 60.0)
+            got = router.wait_for_starting_new_task(
+                ENV, requestor="r-1", immediate=4, timeout_s=2.0)
+            assert len(got) == 4
+            gids = [gid for gid, _ in got]
+            assert all(router.shard_of_grant(g) == owner for g in gids)
+
+            # Before churn: reconciliation keeps every live grant.
+            assert router.notify_servant_running_tasks(loc, gids) == []
+
+            # Decommission the owning shard from routing: the servant
+            # remaps, its next heartbeat registers it elsewhere — but
+            # its in-flight grants must survive reconciliation.
+            router.ring_leave(owner)
+            assert router.shard_for_location(loc) != owner
+            assert router.keep_servant_alive(_info(loc, 4), 60.0)
+            assert router.notify_servant_running_tasks(loc, gids) == []
+            # ... and stay renewable on the owning dispatcher by id.
+            assert router.keep_task_alive(gids, 15.0) == [True] * 4
+
+            # An id the owning shard never issued is still killed.
+            bogus = gids[0] + 4 * 100000
+            assert router.notify_servant_running_tasks(
+                loc, gids + [bogus]) == [bogus]
+        finally:
+            router.stop()
+
+
+class TestHomeShardPinning:
+    def test_home_kwarg_pins_and_skips_round_robin(self):
+        """REVIEW fix: an anonymous request must be ruled and queued
+        on ONE shard — the caller resolves the home once and passes it
+        to both admission_check and the grant path; a pinned call must
+        not burn a round-robin slot."""
+        router = _mk_router(2, steal=StealConfig(enabled=False))
+        try:
+            assert router.resolve_home("") == 0
+            assert router.resolve_home("") == 1
+            # Pinned calls leave the round-robin counter alone.
+            router.admission_check(immediate=1, home=0)
+            r = router.wait_for_starting_new_task_routed(
+                ENV, immediate=1, timeout_s=0.05, home=1)
+            assert r.shard_id == 1
+            assert router.resolve_home("") == 0
+            # A named requestor pins by hash, with or without home.
+            named = _requestor_for_shard(router, 1)
+            r = router.wait_for_starting_new_task_routed(
+                ENV, requestor=named, immediate=1, timeout_s=0.05)
+            assert r.shard_id == 1
+        finally:
+            router.stop()
+
+
+class TestStealSatisfiedPrefetch:
+    def test_prefetch_served_when_steal_covers_immediate(self):
+        """REVIEW fix: when stealing fully satisfies the immediate
+        demand, the home shard is still called with immediate=0 so the
+        allowed prefetch is allocated (parity with the single-
+        dispatcher path, which always forwards allowed prefetch)."""
+        router = _mk_router(2)
+        try:
+            home_loc = donor_loc = None
+            for loc in _servant_keys(256):
+                s = router.shard_for_location(loc)
+                if s == 0 and home_loc is None:
+                    home_loc = loc
+                elif s == 1 and donor_loc is None:
+                    donor_loc = loc
+                if home_loc and donor_loc:
+                    break
+            # Home shard: one servant, 2 free slots.  Donor: 8 slots.
+            assert router.keep_servant_alive(_info(home_loc, 2), 60.0)
+            assert router.keep_servant_alive(_info(donor_loc, 8), 60.0)
+            hot = _requestor_for_shard(router, 0)
+
+            # immediate=3 > home free=2 triggers stealing; the donor
+            # covers all 3, so need hits 0 with prefetch still owed.
+            r = router.wait_for_starting_new_task_routed(
+                ENV, requestor=hot, immediate=3, prefetch=2,
+                timeout_s=2.0)
+            stolen = [g for g in r.grants if g.stolen]
+            local = [g for g in r.grants if not g.stolen]
+            assert len(stolen) == 3
+            assert all(g.shard_id == 1 for g in stolen)
+            # The prefetch landed on the HOME shard's servant.
+            assert len(local) == 2
+            assert all(g.shard_id == 0 for g in local)
+            assert all(g.servant_location == home_loc for g in local)
+        finally:
+            router.stop()
+
+
+class TestShardedRegistryHeadroom:
+    def test_entry_sizes_registries_above_hash_imbalance(self):
+        """REVIEW fix: entry.py must oversize per-shard registries
+        beyond the exact ceil-split — consistent-hash shares run
+        ~1.14x max/min, so exact-split registries overflow and fail
+        keep-alives with 'servant registry full'."""
+        from yadcc_tpu.scheduler.entry import sharded_registry_size
+
+        for fleet, shards in ((50000, 8), (50000, 16), (8192, 4)):
+            per = sharded_registry_size(fleet, shards)
+            split = -(-fleet // shards)
+            assert per >= split * 1.14, (fleet, shards, per)
+            assert per % 256 == 0
+        assert sharded_registry_size(100, 4) == 256  # floor
+
+    def test_expected_imbalance_fits_registry(self):
+        """End-to-end: hash 50k servant keys over 8 shards; every
+        shard's real share must fit the registry entry.py would give
+        it (the exact split provably does NOT fit the max share)."""
+        from collections import Counter
+
+        from yadcc_tpu.scheduler.entry import sharded_registry_size
+
+        ring = ConsistentHash(
+            [(f"shard{i}", 1) for i in range(8)],
+            vnodes_per_weight=SCHEDULER_VNODES_PER_WEIGHT)
+        shares = Counter(ring.pick(k) for k in _servant_keys(50000))
+        per = sharded_registry_size(50000, 8)
+        assert max(shares.values()) <= per
+
+
 class TestAggregateInspect:
     def test_aggregate_equals_sum_of_shards(self):
         """Satellite fix: inspect() must aggregate across shards (sum

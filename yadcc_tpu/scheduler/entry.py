@@ -101,6 +101,22 @@ def resolve_pipeline_depth(flag: str, policy) -> int:
         return 0
 
 
+def sharded_registry_size(max_servants: int, n_shards: int) -> int:
+    """Per-shard registry/pool size for the sharded control plane:
+    the ceil-split of the fleet plus pod_sim's headroom math (+25%,
+    join slack, rounded up to 256 slots).  Consistent-hash routing is
+    not an even split — the ring's measured max/min key share is
+    ~1.14x — so a registry sized to the exact split overflows whenever
+    a shard draws its expected above-mean share, and keep-alives fail
+    with "servant registry full" while the fleet still fits
+    --max-servants."""
+    from ..parallel.mesh import control_plane_shard_slices
+
+    slices = control_plane_shard_slices(max_servants, n_shards)
+    base = max(hi - lo for lo, hi in slices)
+    return max(256, (base * 10 // 8 + 64 + 255) // 256 * 256)
+
+
 def scheduler_start(args) -> None:
     from ..common.parse_size import parse_size
     from ..utils.locktrace import install_from_env
@@ -114,12 +130,9 @@ def scheduler_start(args) -> None:
         # hash routing, cross-shard stealing.  Each shard owns its
         # policy instance (device kernels are not shared across
         # dispatch threads) and warms it before serving.
-        from ..parallel.mesh import control_plane_shard_slices
         from .shard_router import ShardRouter
 
-        slices = control_plane_shard_slices(args.max_servants,
-                                            args.shards)
-        per_shard = max(hi - lo for lo, hi in slices)
+        per_shard = sharded_registry_size(args.max_servants, args.shards)
         policies = [
             make_policy(args.dispatch_policy, per_shard,
                         avoid_self=not args.allow_self_dispatch)

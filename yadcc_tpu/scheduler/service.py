@@ -199,6 +199,15 @@ class SchedulerService:
         if not req.env_desc.compiler_digest:
             raise RpcError(api.scheduler.SCHEDULER_STATUS_INVALID_ARGUMENT,
                            "missing env_desc")
+        # Sharded control plane: resolve the home shard ONCE for the
+        # whole request so the admission ruling and the grant path land
+        # on the same shard's ladder (an anonymous peer is routed
+        # round-robin — two separate resolutions would rule on one
+        # shard and queue on another).  A plain dispatcher has no
+        # resolve_home and takes the old path below.
+        resolve_home = getattr(self.dispatcher, "resolve_home", None)
+        home = (resolve_home(ctx.peer)
+                if resolve_home is not None else None)
         # Overload ladder (doc/robustness.md): rule BEFORE the request
         # queues.  Shedding is never silent — LOCAL_ONLY and REJECT
         # answer immediately with an explicit verdict (+ retry-after),
@@ -206,7 +215,8 @@ class SchedulerService:
         decision = self.dispatcher.admission_check(
             immediate=req.immediate_reqs or 1,
             prefetch=req.prefetch_reqs,
-            requestor=ctx.peer)
+            requestor=ctx.peer,
+            **({} if home is None else {"home": home}))
         if decision.flow != admission.FLOW_NONE:
             resp = api.scheduler.WaitForStartingTaskResponse(
                 flow_control=decision.flow,
@@ -229,6 +239,7 @@ class SchedulerService:
                           if decision.prefetch_allowed else 0),
                 lease_s=lease_ms / 1000.0,
                 timeout_s=wait_ms / 1000.0,
+                home=home,
             )
             if not routed.grants:
                 raise RpcError(

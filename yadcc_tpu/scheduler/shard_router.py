@@ -253,11 +253,15 @@ class ShardRouter:
         membership churn (tests/test_shard_router.py invariants)."""
         return int(self._ring.pick(location)[len("shard"):])
 
-    def _route_request(self, requestor: str) -> int:
+    def resolve_home(self, requestor: str) -> int:
         """Home shard for a grant request: the requestor's consistent-
         hash shard (delegates are pinned, so their keep-alive/free
         traffic and their grants co-locate), round-robin when the
-        caller is anonymous."""
+        caller is anonymous.  Round-robin draws a FRESH shard per
+        call, so a caller pairing an admission ruling with a grant
+        request must resolve once and pass the shard to both (the
+        ``home`` kwarg) — otherwise an anonymous request is ruled on
+        one shard's ladder and queued on another's."""
         if requestor:
             return self.shard_for_location(requestor)
         with self._lock:
@@ -294,17 +298,42 @@ class ShardRouter:
     def notify_servant_running_tasks(
             self, location: str, reported_grant_ids: Sequence[int]
     ) -> List[int]:
-        return self._shards[self.shard_for_location(location)] \
-            .notify_servant_running_tasks(location, reported_grant_ids)
+        """Reconcile per GRANT, not per servant.  Each reported grant
+        is judged by its OWNING dispatcher (``shard_of_grant``) — the
+        only registry that can know it.  Routing the whole report by
+        the servant's CURRENT ring shard would, after ring_leave/
+        ring_join remaps the servant, land it on a dispatcher with no
+        record of it, whose "never knew this id" answer is kill-all:
+        one shard decommission would mass-kill in-flight work on every
+        remapped servant, violating ring_leave's contract that
+        outstanding grants stay renewable on the owning dispatcher.
+        The current ring shard is still always consulted (with its
+        subset, possibly empty) so zombie release keeps happening
+        where the servant is registered; a grant whose owning shard no
+        longer knows it (freed, expired, lease aged out) is killed as
+        before."""
+        by_shard: Dict[int, List[int]] = defaultdict(list)
+        for gid in reported_grant_ids:
+            by_shard[self.shard_of_grant(gid)].append(gid)
+        by_shard.setdefault(self.shard_for_location(location), [])
+        kill: List[int] = []
+        for s, ids in by_shard.items():
+            kill.extend(
+                self._shards[s].notify_servant_running_tasks(location, ids))
+        return kill
 
     def admission_check(self, immediate: int = 1, prefetch: int = 0,
-                        requestor: str = "") -> AdmissionDecision:
+                        requestor: str = "",
+                        home: Optional[int] = None) -> AdmissionDecision:
         """Rule on the HOME shard's ladder — the shard this requestor's
         grants queue on.  Shards shed independently: a hot shard that
         stealing cannot relieve degrades alone instead of dragging the
-        healthy ones with it."""
-        return self._shards[self._route_request(requestor)] \
-            .admission_check(immediate, prefetch)
+        healthy ones with it.  Pass ``home`` (from ``resolve_home``)
+        when the same request will also take the grant path, so both
+        land on the same shard even for an anonymous requestor."""
+        if home is None:
+            home = self.resolve_home(requestor)
+        return self._shards[home].admission_check(immediate, prefetch)
 
     def wait_for_starting_new_task(self, env_digest: str, *,
                                    min_version: int = 0,
@@ -326,11 +355,17 @@ class ShardRouter:
                                           prefetch: int = 0,
                                           lease_s: float = 15.0,
                                           timeout_s: float = 5.0,
+                                          home: Optional[int] = None,
                                           ) -> RoutedGrants:
         """The sharded grant path: steal first when the home shard is
         demonstrably outrun, then the normal PR-2 blocking allocation
-        on the home shard for the remainder."""
-        home = self._route_request(requestor)
+        on the home shard for the remainder (which also services the
+        prefetch allocation, even when stealing covered all the
+        immediate demand — prefetch is never stolen, only home-
+        queued).  ``home`` pins the shard ``resolve_home`` already
+        picked for this request's admission ruling."""
+        if home is None:
+            home = self.resolve_home(requestor)
         d = self._shards[home]
         out = RoutedGrants(shard_id=home)
         need = max(0, immediate)
@@ -355,7 +390,12 @@ class ShardRouter:
                         out.grants.append(
                             RoutedGrant(gid, loc, donor, True))
                         need -= 1
-        if need > 0:
+        if need > 0 or prefetch > 0:
+            # need == 0 with prefetch > 0 (stealing covered all the
+            # immediate demand): still call home with immediate=0 so
+            # the allowed prefetch is allocated, matching the single-
+            # dispatcher path; the request completes after one cycle
+            # since no immediate demand remains.
             remaining = max(0.0, timeout_s - (self._clock.now() - t0))
             for gid, loc in d.wait_for_starting_new_task(
                     env_digest, min_version=min_version,

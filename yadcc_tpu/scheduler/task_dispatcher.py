@@ -1140,7 +1140,16 @@ class TaskDispatcher:
     def _expire_pending_locked(self, now: float) -> None:
         still = []
         for req in self._pending:
-            if req.immediate_left <= 0 or now >= req.deadline:
+            # A prefetch-only request (immediate=0; the sharded router
+            # sends these when stealing covered all the immediate
+            # demand) rides exactly one cycle — which zeroes
+            # prefetch_left — before completing; sweeping it on
+            # immediate_left alone would expire it before any cycle
+            # could allocate its prefetch.
+            prefetch_pending = (req.prefetch_left > 0
+                                and not req.first_cycle_done)
+            if (req.immediate_left <= 0 and not prefetch_pending) \
+                    or now >= req.deadline:
                 req.done.set()
             else:
                 still.append(req)
