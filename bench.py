@@ -433,6 +433,26 @@ def main() -> None:
     except Exception:
         sharded_aps = None
 
+    # RPC front-end canaries (rpc/aio_server.py, doc/benchmarks.md
+    # "RPC front end"): concurrent long-poll connections a small aio
+    # connection storm sustains with zero errors, and grant_call p99
+    # through the aio front end's parked WaitForStartingTask on the
+    # pod_sim pump rig — the in-harness twins of
+    # artifacts/rpc_frontend_ab.json.
+    try:
+        from yadcc_tpu.tools.cluster_sim import \
+            quick_storm_concurrent_connections
+
+        storm_conns = quick_storm_concurrent_connections()
+    except Exception:
+        storm_conns = None
+    try:
+        from yadcc_tpu.tools.pod_sim import quick_aio_grant_call_p99_ms
+
+        aio_grant_p99 = quick_aio_grant_call_p99_ms()
+    except Exception:
+        aio_grant_p99 = None
+
     # Hostile-world survival canaries (tools/scenarios.py,
     # doc/robustness.md): the p99 latency of an explicit REJECT verdict
     # under a smoke 4x-overload ladder storm (a rejection is an
@@ -447,6 +467,13 @@ def main() -> None:
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 9 (r14+): adds `concurrent_connections` (idle
+        # long-poll clients a small aio-front-end connection storm
+        # sustains with zero errors, tools/cluster_sim --clients) and
+        # `grant_call_p99_ms` (grant RPC p99 through the aio front
+        # end's parked WaitForStartingTask on the pod_sim pump rig) —
+        # the event-loop front end canaries (doc/benchmarks.md "RPC
+        # front end").  Every v8 field is still emitted.
         # Version 8 (r13+): adds `sharded_assignments_per_sec` — the
         # sharded-control-plane canary (a 4-shard ShardRouter smoke
         # through the full RPC grant path, tools/pod_sim;
@@ -477,7 +504,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 8,
+        "harness_version": 9,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -515,6 +542,8 @@ def main() -> None:
         "aot_fanout_compiles_per_sec": aot_cps,
         "autotune_sweep_dedup_ratio": autotune_dedup,
         "sharded_assignments_per_sec": sharded_aps,
+        "concurrent_connections": storm_conns,
+        "grant_call_p99_ms": aio_grant_p99,
         "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
         "survival_compile_success_rate": hostile.get(
             "survival_compile_success_rate"),

@@ -1324,3 +1324,78 @@ def test_execute_command_reaps_child_on_sink_failure():
         cmd.subprocess.Popen = orig
     assert procs and procs[0].poll() is not None, \
         "child left running after sink failure"
+
+
+# ---------------------------------------------------------------------------
+# aio-blocking (the event-loop front end's no-blocking-in-coroutines rule)
+# ---------------------------------------------------------------------------
+
+
+AIO_SNIPPET = '''
+import asyncio
+import time
+
+
+async def bad_sleep(self):
+    time.sleep(0.1)                     # finding: blocks the loop
+
+
+async def bad_socket(sock):
+    data = sock.recv(1024)              # finding: socket I/O
+    return data
+
+
+async def bad_rpc(chan, req, cls):
+    return chan.call("svc", "M", req, cls)   # finding: sync RPC .call
+
+
+async def bad_bare_wait(ev):
+    ev.wait()                           # finding: thread-blocking wait
+
+
+async def good_asyncio():
+    await asyncio.sleep(0.1)            # awaited asyncio: exempt
+
+
+async def good_executor(loop, pool, fn):
+    return await loop.run_in_executor(pool, fn)
+
+
+async def hidden_in_await_args(send, sock):
+    await send(sock.recv(1))            # finding: arg of awaited call
+
+
+async def suppressed_sleep():
+    time.sleep(0.01)  # ytpu: allow(aio-blocking)  # startup settle, loop not serving yet
+
+
+def sync_helper_is_fine(sock):
+    return sock.recv(1024)              # sync def: out of scope
+'''
+
+
+def test_aio_blocking_family(tmp_path):
+    findings, _ = run_snippet(tmp_path, AIO_SNIPPET, subdir="rpc")
+    hits = live(findings, "aio-blocking")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 5, msgs
+    assert "bad_sleep" in msgs and "bad_socket" in msgs
+    assert "bad_rpc" in msgs and "bad_bare_wait" in msgs
+    assert "hidden_in_await_args" in msgs
+    # The suppression with a reason is honored (and not counted live).
+    assert not [f for f in findings
+                if f.rule == "aio-blocking" and f.suppressed is False
+                and "suppressed_sleep" in f.message]
+
+
+def test_aio_blocking_scoped_to_rpc(tmp_path):
+    findings, _ = run_snippet(tmp_path, AIO_SNIPPET, subdir="daemon")
+    assert not live(findings, "aio-blocking")
+
+
+def test_aio_package_is_clean():
+    """The shipped event-loop front end must satisfy its own rule."""
+    findings, _ = analyze_paths(
+        [os.path.join(PKG_DIR, "rpc")], AnalyzerConfig())
+    assert not live(findings, "aio-blocking"), \
+        [f.message for f in live(findings, "aio-blocking")]

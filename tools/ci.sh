@@ -105,6 +105,22 @@ if ! python -m yadcc_tpu.tools.cluster_sim --workload autotune --smoke; then
   fail=1
 fi
 
+echo "== rpc front-end gates (byte parity + connection storm) =="
+# ISSUE 10 gates (doc/benchmarks.md "RPC front end"): the aio
+# event-loop front end must produce byte-identical reply frames to the
+# threaded transport over the smoke corpus (exit 2 = divergence), and
+# a small connection storm against the aio HTTP front end must lose no
+# client, keep a bounded accept p99, and complete its compile stream.
+if ! python -m yadcc_tpu.tools.rpc_frontend_bench --parity-smoke; then
+  echo "rpc front-end byte-parity smoke FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --clients 200 \
+       --rpc-frontend aio --smoke; then
+  echo "connection-storm smoke (aio) FAILED" >&2
+  fail=1
+fi
+
 echo "== sharded control-plane smoke =="
 # Sharded scheduler gate (doc/scheduler.md "Sharded control plane"): a
 # small hotspot-skewed 4-shard run asserting the plane's invariants —

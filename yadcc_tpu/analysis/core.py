@@ -36,6 +36,9 @@ RULES: Dict[str, str] = {
                   "declared hierarchy (analysis/lock_hierarchy.toml)",
     "block-under-lock": "blocking call (sleep / I/O / RPC / device sync) "
                         "inside a lock body on a scheduler/daemon hot path",
+    "aio-blocking": "blocking call (sleep / file or socket I/O / sync RPC "
+                    ".call / bare wait) inside an async coroutine in the "
+                    "event-loop front end (rpc/)",
     "jit-nondet": "wall-clock or nondeterminism call inside a @jax.jit "
                   "function",
     "jit-tracer-if": "Python branch on a traced argument inside a "
@@ -151,6 +154,9 @@ class AnalyzerConfig:
     hot_path_fragments: Tuple[str, ...] = ("scheduler", "daemon")
     # Path fragments selecting the modules where jit hygiene applies.
     jit_path_fragments: Tuple[str, ...] = ("ops", "parallel")
+    # Path fragments selecting the modules where aio-blocking applies
+    # (the event-loop front end: coroutines there must never block).
+    aio_path_fragments: Tuple[str, ...] = ("rpc",)
     # Lock hierarchy: canonical lock name -> rank (lower acquired
     # first).  Loaded from lock_hierarchy.toml by the CLI.
     lock_ranks: Dict[str, int] = field(default_factory=dict)
@@ -167,6 +173,7 @@ class AnalyzerConfig:
         """The fields a cached result depends on."""
         return {"hot": list(self.hot_path_fragments),
                 "jit": list(self.jit_path_fragments),
+                "aio": list(self.aio_path_fragments),
                 "ranks": dict(self.lock_ranks)}
 
 

@@ -223,6 +223,78 @@ class _BlockUnderLockHooks(Hooks):
             f"({held_desc}) on a hot path"))
 
 
+class _AioBlockingVisitor(ast.NodeVisitor):
+    """aio-blocking: blocking calls inside ``async def`` coroutines in
+    the event-loop front end's scope (rpc/).  A sleep, file/socket I/O
+    or sync RPC ``.call`` on the loop silently regresses every
+    connection it serves to the thread-per-connection latency profile
+    the front end replaced — so it is a finding, same suppression
+    policy as every other rule.
+
+    Awaited calls are exempt (``await asyncio.sleep`` / stream I/O —
+    a *blocking* call is not awaitable, so awaiting one would already
+    be a runtime error), as is anything rooted at ``asyncio`` and the
+    executor hand-off itself (``run_in_executor`` receives a function
+    reference, not a call).  The check still descends into an awaited
+    call's ARGUMENTS: ``await send(sock.recv(1))`` hides a blocking
+    recv in plain sight."""
+
+    def __init__(self, model: ModuleModel, fn: ast.AsyncFunctionDef,
+                 findings: List[Finding]):
+        self.model = model
+        self.fn = fn
+        self.findings = findings
+
+    def visit_Await(self, node: ast.Await) -> None:
+        value = node.value
+        if isinstance(value, ast.Call):
+            # The awaited call itself is exempt; its arguments are not.
+            for arg in value.args:
+                self.visit(arg)
+            for kw in value.keywords:
+                self.visit(kw.value)
+            self.visit(value.func)
+        else:
+            self.visit(value)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # a nested sync def runs wherever it is called, not here
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        pass  # visited in its own right by check_module's walk
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        func = node.func
+        seg = last_segment(func)
+        root = root_segment(func)
+        if root == "asyncio":
+            return
+        what = None
+        if seg in _BLOCKING_LAST_SEG:
+            what = _BLOCKING_LAST_SEG[seg]
+        elif root in _BLOCKING_ROOT and root != seg:
+            what = _BLOCKING_ROOT[root]
+        elif seg == "wait" or seg == "join":
+            what = "thread-blocking wait"
+        if what is None:
+            return
+        self.findings.append(Finding(
+            "aio-blocking", self.model.relpath, node.lineno,
+            f"{what} ({_dotted(func) or seg}) inside coroutine "
+            f"{self.fn.name}: blocking the event loop stalls every "
+            f"connection it serves"))
+
+
+def _check_aio_blocking(model: ModuleModel,
+                        findings: List[Finding]) -> None:
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            visitor = _AioBlockingVisitor(model, node, findings)
+            for stmt in node.body:
+                visitor.visit(stmt)
+
+
 def _check_edges(model: ModuleModel, config: AnalyzerConfig,
                  edges: List[Tuple[str, str, str, int]],
                  findings: List[Finding]) -> None:
@@ -253,6 +325,8 @@ def check_module(model: ModuleModel,
     findings: List[Finding] = []
     edges: List[Tuple[str, str, str, int]] = []
     hot = _in_scope(model.relpath, config.hot_path_fragments)
+    if _in_scope(model.relpath, config.aio_path_fragments):
+        _check_aio_blocking(model, findings)
     for cls, func in iter_functions(model):
         hook_list: List[Hooks] = [
             _GuardedByHooks(model, cls, func, findings),

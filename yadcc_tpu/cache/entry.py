@@ -16,7 +16,7 @@ import time
 
 from ..common.parse_size import parse_size
 from ..common.token_verifier import make_token_verifier_from_flag
-from ..rpc import GrpcServer
+from ..rpc import make_rpc_server
 from ..utils import exposed_vars
 from ..utils.inspect_server import InspectServer
 from ..utils.logging import get_logger
@@ -60,6 +60,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "expires an L1 entry (0 disables expiry)")
     p.add_argument("--acceptable-user-tokens", default="")
     p.add_argument("--acceptable-servant-tokens", default="")
+    p.add_argument("--rpc-frontend", default="threaded",
+                   choices=["threaded", "aio"],
+                   help="serving front end: grpc thread pool (fallback)"
+                        " or the event-loop server (clients then dial "
+                        "aio://host:port; doc/scheduler.md \"RPC front "
+                        "end\")")
     return p
 
 
@@ -107,13 +113,16 @@ def cache_server_start(args) -> None:
     )
     exposed_vars.expose("yadcc/cache", service.inspect)
 
-    server = GrpcServer(f"0.0.0.0:{args.port}", max_workers=32)
+    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}",
+                             max_workers=32)
     server.add_service(service.spec())
     server.start()
-    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect = InspectServer(args.inspect_port, args.inspect_credential,
+                            frontend=args.rpc_frontend)
     inspect.start()
-    logger.info("cache server on :%d (engine=%s), inspect on :%d",
-                args.port, l2.name, inspect.port)
+    logger.info("cache server on :%d (engine=%s, frontend=%s), "
+                "inspect on :%d", args.port, l2.name,
+                args.rpc_frontend, inspect.port)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

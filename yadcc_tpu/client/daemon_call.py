@@ -8,8 +8,9 @@ daemon_call.h:46-52)."""
 from __future__ import annotations
 
 import http.client
+import threading
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from ..common.payload import Payload
 from .env_options import daemon_port
@@ -36,6 +37,63 @@ def set_daemon_call_handler(
     _handler = handler
 
 
+# Keep-alive connection reuse (ISSUE 10 satellite): quota/wait loops
+# used to open a fresh loopback TCP connection PER POLL — a connect/
+# teardown pair every lap for every parked client, and on the aio front
+# end a brand-new parked connection each time.  One persistent
+# HTTP/1.1 connection per thread serves every poll; the stats make the
+# fix observable (reuses >> connects once a long-poll loop runs).
+_conn_tls = threading.local()
+_conn_stats_lock = threading.Lock()
+_conn_stats = {"connects": 0, "reuses": 0, "retries": 0}
+
+
+def daemon_connection_stats() -> Dict[str, int]:
+    with _conn_stats_lock:
+        return dict(_conn_stats)
+
+
+def _bump(key: str) -> None:
+    with _conn_stats_lock:
+        _conn_stats[key] += 1
+
+
+def _drop_conn() -> None:
+    conn = getattr(_conn_tls, "conn", None)
+    if conn is not None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+    _conn_tls.conn = None
+    _conn_tls.port = None
+
+
+def _request_once(method: str, path: str, body, timeout_s: float):
+    """One attempt on the thread's persistent connection; raises on any
+    transport trouble (caller decides whether to retry on a fresh
+    connection)."""
+    port = daemon_port()
+    conn = getattr(_conn_tls, "conn", None)
+    if conn is None or getattr(_conn_tls, "port", None) != port:
+        _drop_conn()
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout_s)
+        _conn_tls.conn = conn
+        _conn_tls.port = port
+        _bump("connects")
+    else:
+        conn.timeout = timeout_s
+        _bump("reuses")
+    conn.request(method, path, body=body or None,
+                 headers={"Content-Type": "application/octet-stream"})
+    resp = conn.getresponse()
+    data = resp.read()
+    return DaemonResponse(resp.status, data,
+                          retry_after_s=_parse_retry_after(
+                              resp.getheader("Retry-After")))
+
+
 def call_daemon(method: str, path: str, body=b"",
                 timeout_s: float = 30.0) -> DaemonResponse:
     """Returns status -1 on connection failure (daemon not running).
@@ -48,19 +106,22 @@ def call_daemon(method: str, path: str, body=b"",
         body = body.join()
     if _handler is not None:
         return _handler(method, path, body)
+    fresh = getattr(_conn_tls, "conn", None) is None
     try:
-        conn = http.client.HTTPConnection("127.0.0.1", daemon_port(),
-                                          timeout=timeout_s)
-        conn.request(method, path, body=body or None,
-                     headers={"Content-Type": "application/octet-stream"})
-        resp = conn.getresponse()
-        data = resp.read()
-        conn.close()
-        return DaemonResponse(resp.status, data,
-                              retry_after_s=_parse_retry_after(
-                                  resp.getheader("Retry-After")))
-    except OSError:
-        return DaemonResponse(-1, b"")
+        return _request_once(method, path, body, timeout_s)
+    except (OSError, http.client.HTTPException):
+        # A kept-alive connection the daemon quietly closed (restart,
+        # idle timeout) surfaces here: retry ONCE on a fresh dial.  A
+        # failure on an already-fresh connection means no daemon.
+        _drop_conn()
+        if fresh:
+            return DaemonResponse(-1, b"")
+        _bump("retries")
+        try:
+            return _request_once(method, path, body, timeout_s)
+        except (OSError, http.client.HTTPException):
+            _drop_conn()
+            return DaemonResponse(-1, b"")
 
 
 def _parse_retry_after(value: Optional[str]) -> Optional[float]:

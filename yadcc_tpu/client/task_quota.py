@@ -32,7 +32,11 @@ def acquire_task_quota(lightweight: bool, timeout_s: float = 3600.0,
     # handler crash, 404 from an older daemon) used to re-POST with
     # zero delay until the 3600s timeout: a hot spin against a loopback
     # socket.  Every non-200 retry now paces through the shared backoff,
-    # honoring the daemon's Retry-After when it sent one.
+    # honoring the daemon's Retry-After when it sent one.  Each lap
+    # rides call_daemon's persistent keep-alive connection (one dial
+    # for the whole poll loop, not one per lap — on the aio front end
+    # that also means one parked server-side connection instead of a
+    # fresh accept per poll; daemon_call.daemon_connection_stats()).
     backoff = Backoff(initial_s=0.05, max_s=5.0, sleep=_sleep)
     while True:
         resp = call_daemon("POST", "/local/acquire_quota", body)

@@ -21,7 +21,7 @@ import socket
 import threading
 import time
 
-from ..rpc import GrpcServer
+from ..rpc import make_rpc_server
 from ..utils import exposed_vars
 from ..utils.inspect_server import InspectServer
 from ..utils.logging import get_logger
@@ -103,6 +103,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--debugging-always-use-servant-at", default="",
                    help="debug only: dial THIS servant for every "
                         "dispatched task instead of the granted one")
+    p.add_argument("--rpc-frontend", default="threaded",
+                   choices=["threaded", "aio"],
+                   help="serving front end for BOTH roles (doc/"
+                        "daemon.md \"RPC front end\"): 'threaded' = "
+                        "ThreadingHTTPServer + grpc thread pool "
+                        "(fallback/A-B baseline); 'aio' = the event-"
+                        "loop front end — local long-polls "
+                        "(acquire_quota, wait_for_*) park as loop "
+                        "continuations, and peer servants are dialed "
+                        "aio:// (fleet-wide choice)")
     return p
 
 
@@ -173,7 +183,8 @@ def daemon_start(args) -> None:
         bundle_dirs=[d for d in
                      args.extra_compiler_bundle_dirs.split(",") if d])
     engine = ExecutionEngine(max_concurrency=max(capacity, 1))
-    servant_server = GrpcServer(f"0.0.0.0:{args.serving_port}")
+    servant_server = make_rpc_server(args.rpc_frontend,
+                                     f"0.0.0.0:{args.serving_port}")
     config.location = args.location or \
         f"{_guess_local_ip(args.scheduler_uri)}:{servant_server.port}"
     config_keeper = ConfigKeeper(args.scheduler_uri, args.token)
@@ -213,6 +224,10 @@ def daemon_start(args) -> None:
         # sweep-level winner record) through the servant role's writer
         # — static token, same as compile-output fills.
         cache_writer=cache_writer,
+        # The front end is a fleet-wide choice: an aio daemon's peers
+        # serve aio:// too (doc/daemon.md "RPC front end").
+        servant_scheme=("aio://" if args.rpc_frontend == "aio"
+                        else "grpc://"),
     )
     monitor = LocalTaskMonitor(
         max_heavy_tasks=config.max_local_tasks,
@@ -225,14 +240,16 @@ def daemon_start(args) -> None:
         # The jit persistent-compile-cache shim routes: gets through the
         # delegate's Bloom-replicated reader, puts through the servant
         # role's writer (static token, same as compile-output fills).
-        cache_reader=cache_reader, cache_writer=cache_writer)
+        cache_reader=cache_reader, cache_writer=cache_writer,
+        frontend=args.rpc_frontend)
 
     config_keeper.start()
     cache_reader.start()
     running_keeper.start()
     service.start_heartbeat()
     http.start()
-    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect = InspectServer(args.inspect_port, args.inspect_credential,
+                            frontend=args.rpc_frontend)
     inspect.start()
     exposed_vars.expose("yadcc/daemon/engine", engine.inspect)
     exposed_vars.expose("yadcc/daemon/dispatcher", dispatcher.inspect)

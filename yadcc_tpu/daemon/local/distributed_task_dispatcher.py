@@ -66,6 +66,10 @@ class _Entry:
     result: Optional[TaskResult] = None
     done: threading.Event = field(default_factory=threading.Event)
     aborted: bool = False
+    # Parked long-poll continuations (aio front end): fired once with
+    # the TaskResult when the task completes; a waiting client costs
+    # this list entry, not a serving thread.
+    waiters: list = field(default_factory=list)
 
 
 class DistributedTaskDispatcher:
@@ -79,6 +83,10 @@ class DistributedTaskDispatcher:
         pid_prober=None,
         debugging_always_use_servant_at: str = "",
         cache_writer=None,
+        # Transport scheme for dialing peer servants (their registry
+        # locations are bare host:port).  "aio://" when the fleet runs
+        # the event-loop front end (--rpc-frontend aio).
+        servant_scheme: str = "grpc://",
     ):
         self._grants = grant_keeper
         self._config = config_keeper
@@ -94,6 +102,7 @@ class DistributedTaskDispatcher:
         # Debug override (reference --debugging_always_use_servant_at):
         # every servant dial goes HERE; grants still flow normally.
         self._debug_servant = debugging_always_use_servant_at
+        self._servant_scheme = servant_scheme
         self._lock = threading.Lock()
         self._tasks: Dict[int, _Entry] = {}  # guarded by: self._lock
         self._next_id = 1  # guarded by: self._lock
@@ -145,6 +154,25 @@ class DistributedTaskDispatcher:
         entry.done.wait(timeout=timeout_s)
         return entry.result
 
+    def wait_for_task_async(self, task_id: int, on_done) -> bool:
+        """Parked-continuation twin of wait_for_task (aio front end):
+        ``on_done(result)`` fires from the completing task thread, or
+        immediately when the task already finished.  Returns False for
+        an unknown task id (the caller answers 404 — same contract as
+        wait_for_task returning None on unknown).  The caller owns the
+        long-poll deadline: its loop timer answers 503 and the late
+        completion callback becomes a no-op (reply-once responder)."""
+        with self._lock:
+            entry = self._tasks.get(task_id)
+            if entry is None:
+                return False
+            if entry.state != TaskState.DONE:
+                entry.waiters.append(on_done)
+                return True
+            result = entry.result
+        on_done(result)
+        return True
+
     def free_task(self, task_id: int) -> None:
         with self._lock:
             self._tasks.pop(task_id, None)
@@ -178,7 +206,13 @@ class DistributedTaskDispatcher:
             entry.result = result
             entry.state = TaskState.DONE
             entry.completed_at = time.monotonic()
+            waiters, entry.waiters = entry.waiters, []
         entry.done.set()
+        for cb in waiters:  # parked long-polls (aio front end)
+            try:
+                cb(result)
+            except Exception:
+                logger.exception("parked wait continuation failed")
 
     def _try_read_cache(self, entry: _Entry) -> Optional[TaskResult]:
         if self._cache is None or not self._cache.enabled:
@@ -394,7 +428,7 @@ class DistributedTaskDispatcher:
         with self._lock:
             ch = self._channels.get(location)
             if ch is None:
-                scheme = "" if "://" in location else "grpc://"
+                scheme = "" if "://" in location else self._servant_scheme
                 ch = Channel(scheme + location)
                 self._channels[location] = ch
             return ch

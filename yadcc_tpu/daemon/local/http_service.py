@@ -90,6 +90,12 @@ class LocalHttpService:
         # the corresponding route answers 404.
         cache_reader=None,
         cache_writer=None,
+        # "threaded" = the long-standing ThreadingHTTPServer (kept
+        # verbatim as the A/B + fallback); "aio" = the event-loop front
+        # end (rpc/aio_server.py): long-polls (acquire_quota,
+        # wait_for_*) park as continuations + a loop timer instead of a
+        # serving thread each (doc/daemon.md "RPC front end").
+        frontend: str = "threaded",
     ):
         self.monitor = monitor
         self.digest_cache = digest_cache
@@ -98,6 +104,7 @@ class LocalHttpService:
         self.registry = registry or default_registry(digest_cache)
         self.cache_reader = cache_reader
         self.cache_writer = cache_writer
+        self.frontend = frontend
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -158,20 +165,134 @@ class LocalHttpService:
                     except Exception:
                         pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_port
+        if frontend == "aio":
+            from ...rpc.aio_server import AioHttpServer
+
+            self._httpd = None
+            self._aio = AioHttpServer(
+                self._handle_aio, address=f"{host}:{port}",
+                too_large_body=b'{"error":"body exceeds wire cap"}')
+            self.port = self._aio.port
+        else:
+            self._aio = None
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+            self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        if self._aio is not None:
+            return  # the event loop serves from construction
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="local-http", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        if self._aio is not None:
+            self._aio.stop()
+            return
         self._httpd.shutdown()
         self._httpd.server_close()
+
+    # -- aio front end (event-loop routing) ----------------------------------
+
+    def _handle_aio(self, responder) -> None:
+        """Runs ON the loop for every request: long-polls park, quick
+        routes run inline, everything that may touch disk or RPC (cache
+        shim reads, task submission) goes to the bounded worker pool.
+        Route semantics and reply bodies match the threaded front end
+        byte for byte (tools/rpc_frontend_bench.py --parity-smoke)."""
+        if responder.method == "GET":
+            if responder.path == "/local/get_version":
+                responder._reply(200, _to_json(api.local.GetVersionResponse(
+                    built_at=BUILT_AT,
+                    version_for_upgrade=VERSION_FOR_UPGRADE)))
+            else:
+                responder._reply(404)
+            return
+        if responder.method != "POST":
+            responder._reply(501)
+            return
+        path, body = responder.path, responder.request.body
+        if path == "/local/acquire_quota":
+            self._acquire_quota_parked(responder, body)
+            return
+        task_type = self.registry.for_wait(path)
+        if task_type is not None:
+            self._wait_parked(responder, task_type, body)
+            return
+        self._aio.submit(self._route_post_pooled, responder, path, body)
+
+    def _route_post_pooled(self, responder, path: str,
+                           body: bytes) -> None:
+        try:
+            self._route_post(responder, path, body)
+        except Exception:
+            logger.exception("error handling %s", path)
+            responder._reply(500)
+
+    def _acquire_quota_parked(self, responder, body: bytes) -> None:  # ytpu: untrusted(body)
+        req = _from_json(api.local.AcquireQuotaRequest, body)
+
+        def on_grant(ok: bool) -> None:
+            if ok:
+                responder._reply(200,
+                                 _to_json(api.local.AcquireQuotaResponse()))
+            else:
+                # Same pacing contract as the threaded route: the
+                # caller already waited its window server-side.
+                responder._reply(503,
+                                 _to_json(api.local.AcquireQuotaResponse()),
+                                 retry_after_s=0.5)
+
+        responder.release_request()  # parked: keep the continuation only
+        waiter = self.monitor.acquire_async(
+            req.requestor_pid, req.lightweight_task, on_grant)
+        # The deadline half of the parked continuation: a loop timer,
+        # not a polling thread (same clamp as the threaded route).
+        self._aio.call_later(clamp_wait_s(req.milliseconds_to_wait),
+                             waiter.expire)
+
+    def _wait_parked(self, responder, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
+        req = _from_json(task_type.wait_request_cls, body)
+        task_id = req.task_id
+
+        def on_done(result) -> None:
+            if responder.replied or result is None:
+                return
+            # Response assembly (multi-chunk join of possibly-multi-MB
+            # outputs) belongs on the pool, not the loop.
+            self._aio.submit(self._finish_wait_pooled, responder,
+                             task_type, task_id, result)
+
+        if not self.dispatcher.wait_for_task_async(task_id, on_done):
+            responder._reply(404)
+            return
+        responder.release_request()  # parked: keep the continuation only
+
+        def on_deadline() -> None:
+            # Still running at the poll window's end: 503, client
+            # re-polls (threaded-route semantics).  The completion
+            # continuation racing us is settled by the reply-once
+            # responder.
+            responder._reply(
+                404 if not self.dispatcher.is_known(task_id) else 503)
+
+        self._aio.call_later(
+            min(req.milliseconds_to_wait, 10_000) / 1000.0, on_deadline)
+
+    def _finish_wait_pooled(self, responder, task_type, task_id: int,
+                            result) -> None:
+        resp, out_chunks = task_type.build_wait_response(result)
+        payload = multi_chunk.make_multi_chunk_payload(
+            [_to_json(resp)] + list(out_chunks))
+        # Free only if OUR reply won: when the deadline timer already
+        # answered 503, the client never saw this result and will
+        # re-poll for it — freeing here would turn that into a 404.
+        if responder._reply(200, payload,
+                            content_type="application/octet-stream"):
+            self.dispatcher.free_task(task_id)
 
     # -- routing -------------------------------------------------------------
 

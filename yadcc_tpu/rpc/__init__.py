@@ -40,3 +40,17 @@ __all__ = [
     "register_mock_server",
     "unregister_mock_server",
 ]
+
+
+def make_rpc_server(frontend: str, address: str, *, max_workers: int = 32):
+    """Factory for the `--rpc-frontend aio|threaded` flag: "threaded" is
+    the grpc thread-pool server (the long-standing default, kept
+    verbatim as the A/B + fallback), "aio" the event-loop front end
+    (rpc/aio_server.py, doc/scheduler.md "RPC front end")."""
+    if frontend == "aio":
+        from .aio_server import AioRpcServer
+
+        return AioRpcServer(address, max_workers=max_workers)
+    if frontend in ("threaded", "grpc"):
+        return GrpcServer(address, max_workers=max_workers)
+    raise ValueError(f"unknown rpc frontend {frontend!r}")

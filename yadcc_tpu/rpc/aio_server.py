@@ -1,0 +1,990 @@
+"""Async event-loop RPC/HTTP front end.
+
+The reference rides flare's M:N fiber runtime so tens of thousands of
+delegates can hold long-poll waits without burning a thread stack each;
+our serving layer was thread-per-connection (``ThreadingHTTPServer``,
+grpc's ``ThreadPoolExecutor``).  This module rebuilds the serving path
+on ONE selector event loop (asyncio) while keeping the wire *frame*
+format byte-identical (transport.py: ``[u32 status][u32 meta_len][meta]
+[attachment]``):
+
+* :class:`AioRpcServer` — hosts the same ``ServiceSpec`` objects the
+  grpc transport mounts, over a raw-TCP length-prefixed envelope.
+  Frames are parsed incrementally from non-blocking sockets
+  (:class:`FrameStreamParser` — partial reads, pipelining and
+  slow-loris byte-drip are all just states of the parser), handlers run
+  unmodified on a BOUNDED worker pool, and responses gather-write their
+  PR-4 ``Payload`` segments straight to the transport (no join).
+* *Parked* methods (``ServiceSpec.add_parked``): long-poll handlers
+  that would otherwise park a worker thread instead take a ``done``
+  continuation.  A waiting client then costs a pending-table entry and
+  a loop timer — not an 8MB thread stack and two condvar handoffs.
+  The completing thread (e.g. the scheduler's dispatch thread) calls
+  ``done(...)`` directly and the loop writes the bytes.
+* :class:`AioChannel` — the matching sync client (``aio://host:port``),
+  one persistent connection per target with seq-matched pipelining, so
+  grant-keeper dry polls stop reconnecting per poll.
+  :class:`AsyncAioChannel` is the loop-native client used by simulators
+  to hold thousands of concurrent calls on a handful of threads.
+* :class:`AioHttpServer` — a minimal HTTP/1.1 server with the same
+  responder surface as ``BaseHTTPRequestHandler`` subset the daemon's
+  routes use (``_reply``), keep-alive by default, long-polls parked via
+  the same continuation discipline.
+
+Stage accounting: the servers record ``accept`` / ``read`` / ``parse``
+/ ``write`` into a ``utils.stagetimer.StageTimer`` so the residual
+transport time in grant_call decompositions is attributable
+(doc/scheduler.md "Grant-path stage budget").
+
+Scope discipline (enforced by ``ytpu-analyze``'s ``aio-blocking``
+rule): coroutines in this package must never make blocking calls —
+sleep, file/socket I/O, or sync RPC ``.call`` — or the loop silently
+regresses to the thread-per-connection latency profile it replaces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from ..utils.stagetimer import FRONTEND_STAGES, StageTimer
+from .transport import (
+    Channel,
+    Payload,
+    RpcContext,
+    RpcError,
+    ServiceSpec,
+    STATUS_METHOD_NOT_FOUND,
+    STATUS_TIMEOUT,
+    STATUS_TRANSPORT_FAILURE,
+    apply_faults,
+    decode_frame_views,
+    dispatch_frame_payload,
+    encode_frame,
+    encode_frame_payload,
+)
+
+logger = get_logger("rpc.aio")
+
+# Envelope framing over the TCP stream.  Both directions:
+#
+#     [u32 len][u32 seq][payload bytes...]      (len counts seq+payload)
+#
+# Request payload:  [u16 svc_len][u16 method_len][svc][method][frame]
+# Response payload: [frame]
+#
+# The *frame* bytes are byte-identical to what the grpc transport
+# carries for the same call — that is the wire-parity claim the
+# dataplane-corpus smoke proves (tools/rpc_frontend_bench.py).
+_ENVELOPE = struct.Struct("<II")
+_REQ_PREAMBLE = struct.Struct("<HH")
+_MAX_ENVELOPE = (1 << 30) + 64  # grpc _MAX_MESSAGE parity + preamble
+
+
+class ProtocolError(Exception):
+    """Unrecoverable stream corruption; the connection must close."""
+
+
+class FrameStreamParser:
+    """Incremental envelope parser for the raw-TCP frame transport.
+
+    ``feed(data)`` returns every complete ``(seq, payload)`` message the
+    stream holds so far — zero on a partial read, many on a pipelined
+    burst; a slow-loris byte-drip simply keeps returning [].  Oversized
+    or nonsense lengths raise :class:`ProtocolError` (the stream cannot
+    be resynchronized).
+    """
+
+    __slots__ = ("_buf", "_need", "_seq")
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._need = -1  # payload bytes still unknown
+        self._seq = 0
+
+    def feed(self, data) -> List[Tuple[int, bytes]]:
+        self._buf += data
+        out: List[Tuple[int, bytes]] = []
+        while True:
+            if self._need < 0:
+                if len(self._buf) < _ENVELOPE.size:
+                    break
+                length, seq = _ENVELOPE.unpack_from(self._buf)
+                if length < 4 or length > _MAX_ENVELOPE:
+                    raise ProtocolError(f"bad envelope length {length}")
+                self._need = length - 4  # seq already consumed
+                self._seq = seq
+                del self._buf[:_ENVELOPE.size]
+            if len(self._buf) < self._need:
+                break
+            payload = bytes(self._buf[: self._need])
+            del self._buf[: self._need]
+            self._need = -1
+            out.append((self._seq, payload))
+        return out
+
+    def pending_bytes(self) -> int:
+        return len(self._buf)
+
+
+def split_request_payload(payload) -> Tuple[str, str, memoryview]:
+    """Request payload -> (service, method, frame_view)."""
+    if len(payload) < _REQ_PREAMBLE.size:
+        raise ProtocolError("truncated request preamble")
+    svc_len, m_len = _REQ_PREAMBLE.unpack_from(payload)
+    off = _REQ_PREAMBLE.size
+    if off + svc_len + m_len > len(payload):
+        raise ProtocolError("request preamble overruns payload")
+    mv = memoryview(payload)
+    service = bytes(mv[off:off + svc_len]).decode("utf-8", "replace")
+    method = bytes(
+        mv[off + svc_len:off + svc_len + m_len]).decode("utf-8", "replace")
+    return service, method, mv[off + svc_len + m_len:]
+
+
+def make_request_payload(service: str, method: str, frame) -> List[bytes]:
+    svc = service.encode()
+    m = method.encode()
+    return [_REQ_PREAMBLE.pack(len(svc), len(m)), svc, m, frame]
+
+
+def _envelope_segments(seq: int, payload_segments: List[bytes]) -> List:
+    total = 4 + sum(len(s) for s in payload_segments)
+    return [_ENVELOPE.pack(total, seq)] + payload_segments
+
+
+# ---------------------------------------------------------------------------
+# The event loop host.
+# ---------------------------------------------------------------------------
+
+
+class EventLoopThread:
+    """One asyncio loop on one daemon thread, shared by any number of
+    servers.  ``--rpc-frontend aio`` processes run exactly one of these
+    (optionally N with SO_REUSEPORT — see AioRpcServer(reuse_port=));
+    tests create and dispose of them freely."""
+
+    def __init__(self, name: str = "aio-loop"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait(5.0)
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(self._started.set)
+        self.loop.run_forever()
+
+    def run_sync(self, coro, timeout: float = 10.0):
+        """Run a coroutine on the loop from a foreign thread, blocking
+        for its result (setup/teardown plumbing, never the data path)."""
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(timeout)
+
+    def call_soon(self, fn, *args) -> None:
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    def stop(self) -> None:
+        if self.loop.is_closed():
+            return
+
+        def _halt():
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_halt)
+        self._thread.join(timeout=5.0)
+        if not self.loop.is_running():
+            self.loop.close()
+
+
+# ---------------------------------------------------------------------------
+# RPC server.
+# ---------------------------------------------------------------------------
+
+
+class _RpcConnection(asyncio.Protocol):
+    __slots__ = ("server", "parser", "transport", "peer",
+                 "_accepted_at", "_first_request_seen",
+                 "_read_started_at")
+
+    def __init__(self, server: "AioRpcServer"):
+        self.server = server
+        self.parser = FrameStreamParser()
+        self.transport: Optional[asyncio.Transport] = None
+        self.peer = ""
+        self._accepted_at = _time.perf_counter()
+        self._first_request_seen = False
+        self._read_started_at: Optional[float] = None
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        peername = transport.get_extra_info("peername") or ("?", 0)
+        self.peer = f"{peername[0]}:{peername[1]}"
+        self.server._conn_opened(self)
+
+    def connection_lost(self, exc) -> None:
+        self.server._conn_closed(self)
+
+    def data_received(self, data) -> None:
+        timer = self.server.stage_timer
+        now = _time.perf_counter()
+        if self._read_started_at is None:
+            self._read_started_at = now
+        try:
+            t0 = _time.perf_counter()
+            messages = self.parser.feed(data)
+            timer.record("parse", _time.perf_counter() - t0)
+        except ProtocolError as e:
+            logger.warning("rpc stream error from %s: %s", self.peer, e)
+            self.transport.close()
+            return
+        if not messages:
+            return
+        # A request's `read` stage: first byte of its envelope to the
+        # byte that completed it (pipelined requests completing in one
+        # chunk share the chunk's read span).
+        timer.record("read", now - self._read_started_at)
+        self._read_started_at = (
+            None if self.parser.pending_bytes() == 0 else now)
+        if not self._first_request_seen:
+            self._first_request_seen = True
+            timer.record("accept", now - self._accepted_at)
+        for seq, payload in messages:
+            self.server._dispatch(self, seq, payload)
+
+    # -- writes (loop thread only) -----------------------------------------
+
+    def send_payload(self, seq: int, payload: Payload) -> None:
+        if self.transport is None or self.transport.is_closing():
+            return
+        t0 = _time.perf_counter()
+        segments = list(payload.iter_segments())
+        self.transport.writelines(_envelope_segments(seq, segments))
+        self.server.stage_timer.record("write", _time.perf_counter() - t0)
+
+
+class AioRpcServer:
+    """Hosts ServiceSpecs on a TCP port via one event loop.
+
+    Sync handlers run on a bounded ``ThreadPoolExecutor`` (default 8 —
+    handlers are short; long-polls belong in parked methods).  Methods
+    registered via ``ServiceSpec.add_parked`` run ON the loop with a
+    ``done`` continuation and MUST NOT block (ytpu-analyze
+    ``aio-blocking`` enforces this package-wide).
+    """
+
+    def __init__(self, address: str = "127.0.0.1:0", *,
+                 loops: Optional[EventLoopThread] = None,
+                 max_workers: int = 8,
+                 reuse_port: bool = False):
+        self._services: Dict[str, ServiceSpec] = {}
+        self._own_loops = loops is None
+        self.loops = loops or EventLoopThread(name="aio-rpc")
+        self.stage_timer = StageTimer(FRONTEND_STAGES, maxlen=16384)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="aio-rpc-worker")
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        host, _, port = address.rpartition(":")
+        self._asyncio_server = self.loops.run_sync(
+            self._start_server(host or "127.0.0.1", int(port),
+                               reuse_port))
+        self.port = self._asyncio_server.sockets[0].getsockname()[1]
+
+    async def _start_server(self, host, port, reuse_port):
+        return await self.loops.loop.create_server(
+            lambda: _RpcConnection(self), host, port,
+            reuse_port=reuse_port or None, backlog=1024)
+
+    def add_service(self, spec: ServiceSpec) -> None:
+        self._services[spec.service_name] = spec
+
+    def start(self) -> None:
+        pass  # serving from construction; kept for GrpcServer parity
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        async def _close():
+            self._asyncio_server.close()
+            await self._asyncio_server.wait_closed()
+            with self._conn_lock:
+                conns = list(self._conns)
+            for c in conns:
+                if c.transport is not None:
+                    c.transport.close()
+
+        try:
+            self.loops.run_sync(_close())
+        except Exception:
+            pass
+        self._pool.shutdown(wait=False)
+        if self._own_loops:
+            self.loops.stop()
+
+    # -- connection registry -------------------------------------------------
+
+    def _conn_opened(self, conn) -> None:
+        with self._conn_lock:
+            self._conns.add(conn)
+
+    def _conn_closed(self, conn) -> None:
+        with self._conn_lock:
+            self._conns.discard(conn)
+
+    def connection_count(self) -> int:
+        with self._conn_lock:
+            return len(self._conns)
+
+    # -- dispatch (loop thread) ----------------------------------------------
+
+    def _dispatch(self, conn: _RpcConnection, seq: int, payload) -> None:
+        try:
+            service, method, frame = split_request_payload(payload)
+        except ProtocolError as e:
+            logger.warning("rpc preamble error from %s: %s", conn.peer, e)
+            conn.transport.close()
+            return
+        spec = self._services.get(service)
+        if spec is None:
+            conn.send_payload(seq, encode_frame_payload(
+                STATUS_METHOD_NOT_FOUND,
+                f"no service {service}".encode()))
+            return
+        parked = spec.parked.get(method)
+        if parked is not None:
+            self._dispatch_parked(conn, seq, spec, parked, frame)
+            return
+        loop = self.loops.loop
+        fut = loop.run_in_executor(
+            self._pool, dispatch_frame_payload, spec, method, frame,
+            conn.peer)
+        fut.add_done_callback(
+            lambda f: self._send_result(conn, seq, f))
+
+    def _send_result(self, conn, seq, fut) -> None:
+        try:
+            reply = fut.result()
+        except Exception as e:  # handler pool died; keep the connection
+            logger.exception("aio dispatch failed")
+            reply = encode_frame_payload(
+                STATUS_TRANSPORT_FAILURE, f"dispatch error: {e!r}".encode())
+        conn.send_payload(seq, reply)
+
+    def _dispatch_parked(self, conn, seq, spec: ServiceSpec, ms,
+                         frame) -> None:
+        """Long-poll path: the handler runs on the loop, registers its
+        continuation with the owning component and returns without a
+        response.  The completing thread calls ``done`` which encodes
+        and writes from the loop.  The parked client's cost: this
+        closure + whatever pending-table entry the component keeps."""
+        timer = spec.stage_timer
+        t0 = _time.perf_counter()
+        try:
+            _, meta, attachment = decode_frame_views(frame)
+            req = ms.request_cls.FromString(meta)
+        except Exception as e:
+            conn.send_payload(seq, encode_frame_payload(
+                STATUS_TRANSPORT_FAILURE,
+                f"malformed request: {e!r}".encode()))
+            return
+        ctx = RpcContext(peer=conn.peer)
+        fired = [False]
+        fired_lock = threading.Lock()
+
+        def done(resp, *, error: Optional[RpcError] = None) -> None:
+            with fired_lock:
+                if fired[0]:
+                    return
+                fired[0] = True
+            t1 = _time.perf_counter()
+            if error is not None:
+                reply = encode_frame_payload(error.status,
+                                             error.message.encode())
+            else:
+                reply = encode_frame_payload(
+                    0, resp.SerializeToString(), ctx.response_attachment)
+            if timer is not None:
+                timer.record(f"{ms.name}:handler", t1 - t0)
+                timer.record(f"{ms.name}:serialize",
+                             _time.perf_counter() - t1)
+            self.loops.call_soon(conn.send_payload, seq, reply)
+
+        try:
+            ms.handler(req, attachment, ctx, done)
+        except RpcError as e:
+            done(None, error=e)
+        except Exception as e:
+            logger.exception("parked handler %s failed", ms.name)
+            done(None, error=RpcError(STATUS_TRANSPORT_FAILURE,
+                                      f"handler error: {e!r}"))
+
+    def call_later(self, delay_s: float, fn, *args) -> None:
+        """Schedule ``fn`` on the loop — the timer half of a parked
+        continuation (deadline replies, poll re-arms)."""
+        self.loops.call_soon(
+            lambda: self.loops.loop.call_later(delay_s, fn, *args))
+
+
+# ---------------------------------------------------------------------------
+# Clients.
+# ---------------------------------------------------------------------------
+
+# Process-wide connection accounting for the keep-alive claim: dials is
+# sockets actually connected, reuses is calls served on an existing
+# connection (the dry-poll fix in ISSUE 10's satellite is visible as
+# reuses >> dials).
+_conn_stats_lock = threading.Lock()
+_conn_stats = {"dials": 0, "reuses": 0}
+
+
+def _note_dial() -> None:
+    with _conn_stats_lock:
+        _conn_stats["dials"] += 1
+
+
+def _note_reuse() -> None:
+    with _conn_stats_lock:
+        _conn_stats["reuses"] += 1
+
+
+def aio_connection_stats() -> Dict[str, int]:
+    with _conn_stats_lock:
+        return dict(_conn_stats)
+
+
+class _SyncReader(threading.Thread):
+    """Reader side of AioChannel's persistent socket: demuxes pipelined
+    responses to per-seq waiters."""
+
+    def __init__(self, channel: "AioChannel", sock):
+        super().__init__(name="aio-chan-reader", daemon=True)
+        self.channel = channel
+        self.sock = sock
+
+    def run(self) -> None:
+        parser = FrameStreamParser()
+        try:
+            while True:
+                data = self.sock.recv(1 << 16)
+                if not data:
+                    break
+                for seq, payload in parser.feed(data):
+                    self.channel._complete(seq, payload)
+        except (OSError, ProtocolError):
+            pass
+        self.channel._reader_died(self)
+
+
+class AioChannel(Channel):
+    """Sync client channel for ``aio://host:port``.
+
+    One persistent connection per channel; concurrent callers pipeline
+    over it with seq matching (the reader thread demuxes).  Dials are
+    counted once per socket, so long-poll loops that used to reconnect
+    per poll now show up as one dial and N reuses
+    (``aio_connection_stats``)."""
+
+    def __init__(self, uri: str):
+        target = uri[len("aio://"):] if uri.startswith("aio://") else uri
+        self._target = target
+        host, _, port = target.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._lock = threading.Lock()
+        self._sock = None  # guarded by: self._lock
+        self._reader: Optional[_SyncReader] = None  # guarded by: self._lock
+        self._next_seq = 1  # guarded by: self._lock
+        self._waiters: Dict[int, list] = {}  # guarded by: self._lock
+
+    # -- connection lifecycle ------------------------------------------------
+
+    def _ensure_sock(self):
+        import socket as _socket
+
+        with self._lock:
+            if self._sock is not None:
+                _note_reuse()
+                return self._sock
+        sock = _socket.create_connection(self._addr, timeout=10.0)
+        sock.settimeout(None)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        with self._lock:
+            if self._sock is not None:  # raced; keep the winner
+                sock.close()
+                _note_reuse()
+                return self._sock
+            self._sock = sock
+            self._reader = _SyncReader(self, sock)
+            self._reader.start()
+        _note_dial()
+        return sock
+
+    def _complete(self, seq: int, payload: bytes) -> None:
+        with self._lock:
+            waiter = self._waiters.pop(seq, None)
+        if waiter is not None:
+            waiter[1] = payload
+            waiter[0].set()
+
+    def _reader_died(self, reader) -> None:
+        with self._lock:
+            if self._reader is not reader:
+                return  # an old generation; the live socket is fine
+            sock, self._sock, self._reader = self._sock, None, None
+            waiters, self._waiters = self._waiters, {}
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for waiter in waiters.values():
+            waiter[0].set()  # payload stays None -> transport failure
+
+    # -- the call ------------------------------------------------------------
+
+    def call(self, service, method_name, request, response_cls,
+             attachment=b"", timeout=None):
+        apply_faults(self._target, service, method_name)
+        frame = encode_frame(0, request.SerializeToString(), attachment)
+        try:
+            sock = self._ensure_sock()
+        except OSError as e:
+            raise RpcError(STATUS_TRANSPORT_FAILURE,
+                           f"connect {self._target}: {e}") from e
+        event = threading.Event()
+        waiter = [event, None]
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._waiters[seq] = waiter
+        data = b"".join(_envelope_segments(
+            seq, make_request_payload(service, method_name, frame)))
+        try:
+            with self._lock:
+                live = self._sock
+            if live is not sock or live is None:
+                raise OSError("connection replaced")
+            sock.sendall(data)
+        except OSError as e:
+            with self._lock:
+                self._waiters.pop(seq, None)
+            self._teardown()
+            raise RpcError(STATUS_TRANSPORT_FAILURE,
+                           f"send {self._target}: {e}") from e
+        if not event.wait(timeout if timeout is not None else 300.0):
+            with self._lock:
+                self._waiters.pop(seq, None)
+            raise RpcError(STATUS_TIMEOUT,
+                           f"timed out waiting on {self._target}")
+        if waiter[1] is None:
+            raise RpcError(STATUS_TRANSPORT_FAILURE,
+                           f"connection to {self._target} lost")
+        status, meta, att = decode_frame_views(waiter[1])
+        if status != 0:
+            raise RpcError(status, bytes(meta).decode(errors="replace"))
+        return response_cls.FromString(meta), att
+
+    def call_raw(self, service, method_name, frame: bytes,
+                 timeout: Optional[float] = None) -> bytes:
+        """Send a pre-encoded request frame, return the raw reply frame
+        (byte-parity harness; production uses call())."""
+        sock = self._ensure_sock()
+        event = threading.Event()
+        waiter = [event, None]
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._waiters[seq] = waiter
+        sock.sendall(b"".join(_envelope_segments(
+            seq, make_request_payload(service, method_name, frame))))
+        if not event.wait(timeout if timeout is not None else 30.0) or \
+                waiter[1] is None:
+            raise RpcError(STATUS_TRANSPORT_FAILURE, "raw call failed")
+        return waiter[1]
+
+    def _teardown(self) -> None:
+        with self._lock:
+            sock, self._sock, self._reader = self._sock, None, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._teardown()
+
+
+class AsyncAioChannel:
+    """Loop-native client: thousands of concurrent calls on one
+    connection, each an awaiting coroutine instead of a parked thread.
+    Construct and use from ON the loop."""
+
+    def __init__(self, target: str):
+        target = target[len("aio://"):] if target.startswith("aio://") \
+            else target
+        host, _, port = target.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._transport = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_seq = 1
+        self._parser = FrameStreamParser()
+        self._conn_lock: Optional[asyncio.Lock] = None
+
+    async def connect(self) -> None:
+        loop = asyncio.get_running_loop()
+        chan = self
+
+        class _Proto(asyncio.Protocol):
+            def data_received(self, data):
+                for seq, payload in chan._parser.feed(data):
+                    fut = chan._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(payload)
+
+            def connection_lost(self, exc):
+                chan._fail_all()
+
+        self._transport, _ = await loop.create_connection(
+            _Proto, *self._addr)
+        _note_dial()
+
+    def _fail_all(self) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(RpcError(
+                    STATUS_TRANSPORT_FAILURE, "connection lost"))
+
+    async def call(self, service, method_name, request, response_cls,
+                   attachment=b"", timeout: Optional[float] = None):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:  # concurrent callers dial once
+            if self._transport is None or self._transport.is_closing():
+                await self.connect()
+            else:
+                _note_reuse()
+        frame = encode_frame(0, request.SerializeToString(), attachment)
+        seq = self._next_seq
+        self._next_seq += 1
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        self._transport.writelines(_envelope_segments(
+            seq, make_request_payload(service, method_name, frame)))
+        try:
+            payload = await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(seq, None)
+            raise RpcError(STATUS_TIMEOUT, "call timed out") from None
+        status, meta, att = decode_frame_views(payload)
+        if status != 0:
+            raise RpcError(status, bytes(meta).decode(errors="replace"))
+        return response_cls.FromString(meta), att
+
+    def close(self) -> None:
+        if self._transport is not None:
+            self._transport.close()
+            self._transport = None
+
+
+# ---------------------------------------------------------------------------
+# HTTP/1.1 server.
+# ---------------------------------------------------------------------------
+
+_HTTP_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 401: "Unauthorized", 404: "Not Found",
+    413: "Request Entity Too Large", 500: "Internal Server Error",
+    501: "Not Implemented", 503: "Service Unavailable",
+}
+_MAX_HEADER_BYTES = 64 << 10
+
+
+class HttpRequest:
+    __slots__ = ("method", "path", "version", "headers", "body")
+
+    def __init__(self, method, path, version, headers, body):
+        self.method = method
+        self.path = path
+        self.version = version
+        self.headers = headers  # dict, lower-cased keys
+        self.body = body
+
+
+class HttpStreamParser:
+    """Incremental HTTP/1.1 request parser (Content-Length bodies only —
+    every client of the daemon's loopback API sends one; chunked TE is
+    refused upstream with 501).  Tolerates the same adversarial streams
+    as the frame parser: partial reads, pipelining, byte-drip."""
+
+    __slots__ = ("_buf", "_headers_done", "_req", "_body_need", "_cap")
+
+    def __init__(self, max_body: int):
+        self._buf = bytearray()
+        self._headers_done = False
+        self._req: Optional[HttpRequest] = None
+        self._body_need = 0
+        self._cap = max_body
+
+    def feed(self, data) -> List[HttpRequest]:
+        self._buf += data
+        out: List[HttpRequest] = []
+        while True:
+            if not self._headers_done:
+                end = self._buf.find(b"\r\n\r\n")
+                if end < 0:
+                    if len(self._buf) > _MAX_HEADER_BYTES:
+                        raise ProtocolError("oversized header block")
+                    break
+                head = bytes(self._buf[:end]).decode("latin-1")
+                del self._buf[:end + 4]
+                lines = head.split("\r\n")
+                parts = lines[0].split()
+                if len(parts) != 3:
+                    raise ProtocolError(f"bad request line {lines[0]!r}")
+                headers: Dict[str, str] = {}
+                for line in lines[1:]:
+                    k, _, v = line.partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                if "transfer-encoding" in headers:
+                    raise ProtocolError("chunked bodies unsupported")
+                try:
+                    need = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    raise ProtocolError("bad content-length")
+                if need < 0 or need > self._cap:
+                    # Surfaced as a 413 by the server, not a hard close:
+                    # the cap is policy, not stream corruption.
+                    raise BodyOverCap(parts[0], parts[1], headers)
+                self._req = HttpRequest(parts[0], parts[1], parts[2],
+                                        headers, b"")
+                self._body_need = need
+                self._headers_done = True
+            if len(self._buf) < self._body_need:
+                break
+            req = self._req
+            req.body = bytes(self._buf[: self._body_need])
+            del self._buf[: self._body_need]
+            self._headers_done = False
+            self._req = None
+            self._body_need = 0
+            out.append(req)
+        return out
+
+
+class BodyOverCap(Exception):
+    """Content-Length over the wire cap: reply 413, keep parsing is
+    impossible (the body bytes would follow) so the connection closes
+    after the reply."""
+
+    def __init__(self, method, path, headers):
+        super().__init__("body over cap")
+        self.method = method
+        self.path = path
+        self.headers = headers
+
+
+class AioHttpResponder:
+    """The reply surface handlers get — duck-type compatible with the
+    ``_reply`` subset of the threaded BaseHTTPRequestHandler routes.
+    ``_reply`` is once-only and thread-safe: a parked long-poll's
+    completion and its deadline timer may race, the first wins."""
+
+    __slots__ = ("server", "_conn", "request", "method", "path",
+                 "headers", "_reply_lock", "_replied")
+
+    def __init__(self, server: "AioHttpServer", conn: "_HttpConnection",
+                 request: HttpRequest):
+        self.server = server
+        self._conn = conn
+        self.request = request
+        self.method = request.method
+        self.path = request.path
+        self.headers = request.headers
+        self._reply_lock = threading.Lock()
+        self._replied = False
+
+    def release_request(self) -> None:
+        """Drop the request body/headers before parking: an idle
+        long-poll client should cost its continuation, not its whole
+        parsed request (the ISSUE-10 parked-memory budget)."""
+        self.request = None
+        self.headers = None
+
+    @property
+    def replied(self) -> bool:
+        with self._reply_lock:
+            return self._replied
+
+    def _reply(self, code: int, body=b"",
+               content_type: str = "application/json",
+               retry_after_s: Optional[float] = None) -> bool:
+        """Returns True iff THIS call produced the response — parked
+        completions and deadline timers race, and cleanup that must
+        happen exactly once (e.g. free_task) belongs to the winner."""
+        with self._reply_lock:
+            if self._replied:
+                return False
+            self._replied = True
+        head = [f"HTTP/1.1 {code} {_HTTP_STATUS_TEXT.get(code, 'X')}",
+                f"Content-Type: {content_type}",
+                f"Content-Length: {len(body)}"]
+        if retry_after_s is not None:
+            head.append(f"Retry-After: {retry_after_s:g}")
+        head.append("\r\n")
+        header_bytes = "\r\n".join(head).encode("latin-1")
+        segments = [header_bytes]
+        if isinstance(body, Payload):
+            segments.extend(body.iter_segments())
+        elif body:
+            segments.append(body)
+        self.server.loops.call_soon(self._conn.write_segments, segments)
+        return True
+
+
+class _HttpConnection(asyncio.Protocol):
+    __slots__ = ("server", "parser", "transport", "peer",
+                 "_accepted_at", "_first_seen")
+
+    def __init__(self, server: "AioHttpServer"):
+        self.server = server
+        self.parser = HttpStreamParser(server.max_body)
+        self.transport = None
+        self.peer = ""
+        self._accepted_at = _time.perf_counter()
+        self._first_seen = False
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        peername = transport.get_extra_info("peername") or ("?", 0)
+        self.peer = f"{peername[0]}:{peername[1]}"
+        self.server._conn_opened(self)
+
+    def connection_lost(self, exc) -> None:
+        self.server._conn_closed(self)
+
+    def data_received(self, data) -> None:
+        timer = self.server.stage_timer
+        try:
+            t0 = _time.perf_counter()
+            requests = self.parser.feed(data)
+            timer.record("parse", _time.perf_counter() - t0)
+        except BodyOverCap:
+            body = self.server.too_large_body
+            self.transport.write(
+                (f"HTTP/1.1 413 Request Entity Too Large\r\n"
+                 f"Content-Type: application/json\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 f"Connection: close\r\n\r\n").encode("latin-1") + body)
+            self.transport.close()
+            return
+        except ProtocolError as e:
+            logger.warning("http stream error from %s: %s", self.peer, e)
+            self.transport.close()
+            return
+        if requests and not self._first_seen:
+            self._first_seen = True
+            timer.record("accept", _time.perf_counter() - self._accepted_at)
+        for req in requests:
+            responder = AioHttpResponder(self.server, self, req)
+            try:
+                self.server.handler_fn(responder)
+            except Exception:
+                logger.exception("http handler failed for %s", req.path)
+                responder._reply(500)
+
+    def write_segments(self, segments) -> None:
+        if self.transport is None or self.transport.is_closing():
+            return
+        t0 = _time.perf_counter()
+        self.transport.writelines(segments)
+        self.server.stage_timer.record(
+            "write", _time.perf_counter() - t0)
+
+
+class AioHttpServer:
+    """Event-loop HTTP/1.1 front end.
+
+    ``handler_fn(responder)`` runs on the loop for every request: it
+    must either reply, park (register a continuation + deadline timer),
+    or hand blocking work to ``submit()``'s bounded pool.  Keep-alive
+    is the default (HTTP/1.1); an idle parked client costs its
+    responder + timer, nothing else."""
+
+    def __init__(self, handler_fn: Callable[[AioHttpResponder], None],
+                 address: str = "127.0.0.1:0", *,
+                 loops: Optional[EventLoopThread] = None,
+                 max_workers: int = 8,
+                 max_body: int = 1 << 30,
+                 too_large_body: bytes = b'{"error":"body too large"}'):
+        self.handler_fn = handler_fn
+        self.max_body = max_body
+        self.too_large_body = too_large_body
+        self._own_loops = loops is None
+        self.loops = loops or EventLoopThread(name="aio-http")
+        self.stage_timer = StageTimer(FRONTEND_STAGES, maxlen=16384)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="aio-http-worker")
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        host, _, port = address.rpartition(":")
+        self._asyncio_server = self.loops.run_sync(
+            self._start(host or "127.0.0.1", int(port)))
+        self.port = self._asyncio_server.sockets[0].getsockname()[1]
+
+    async def _start(self, host, port):
+        return await self.loops.loop.create_server(
+            lambda: _HttpConnection(self), host, port, backlog=1024)
+
+    def submit(self, fn, *args) -> None:
+        """Run blocking route work on the bounded pool."""
+        self._pool.submit(self._guard, fn, *args)
+
+    @staticmethod
+    def _guard(fn, *args) -> None:
+        try:
+            fn(*args)
+        except Exception:
+            logger.exception("http pool task failed")
+
+    def call_later(self, delay_s: float, fn, *args) -> None:
+        self.loops.call_soon(
+            lambda: self.loops.loop.call_later(delay_s, fn, *args))
+
+    def connection_count(self) -> int:
+        with self._conn_lock:
+            return len(self._conns)
+
+    def _conn_opened(self, conn) -> None:
+        with self._conn_lock:
+            self._conns.add(conn)
+
+    def _conn_closed(self, conn) -> None:
+        with self._conn_lock:
+            self._conns.discard(conn)
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        async def _close():
+            self._asyncio_server.close()
+            await self._asyncio_server.wait_closed()
+            with self._conn_lock:
+                conns = list(self._conns)
+            for c in conns:
+                if c.transport is not None:
+                    c.transport.close()
+
+        try:
+            self.loops.run_sync(_close())
+        except Exception:
+            pass
+        self._pool.shutdown(wait=False)
+        if self._own_loops:
+            self.loops.stop()

@@ -59,6 +59,14 @@ class RpcContext:
 # returns the response message (attachment goes via ctx).
 Handler = Callable[[object, bytes, RpcContext], object]
 
+# A parked handler additionally takes a `done` continuation and returns
+# nothing: it registers the continuation with the owning component and
+# the COMPLETING thread calls done(response) (or done(None, error=
+# RpcError(...))) exactly once, from any thread.  Only the aio front
+# end (rpc/aio_server.py) consults these; thread-per-request transports
+# keep using the blocking twin registered under the same name.
+ParkedHandler = Callable[[object, bytes, RpcContext, Callable], None]
+
 
 @dataclass
 class MethodSpec:
@@ -74,14 +82,25 @@ class ServiceSpec:
     `stage_timer` (optional, a utils.stagetimer.StageTimer) makes
     dispatch_frame record per-method `<Method>:handler` and
     `<Method>:serialize` stages — the server-side half of the grant
-    path's latency decomposition (doc/scheduler.md)."""
+    path's latency decomposition (doc/scheduler.md).
+
+    `parked` maps long-poll methods to their continuation-style
+    handlers (see ParkedHandler): on the aio front end a waiting client
+    is a parked continuation on the event loop instead of a parked
+    worker thread.  Methods without a parked variant run their blocking
+    handler on the front end's bounded pool."""
 
     service_name: str
     methods: Dict[str, MethodSpec] = field(default_factory=dict)
     stage_timer: Optional[object] = None
+    parked: Dict[str, MethodSpec] = field(default_factory=dict)
 
     def add(self, name: str, request_cls: type, handler: Handler) -> None:
         self.methods[name] = MethodSpec(name, request_cls, handler)
+
+    def add_parked(self, name: str, request_cls: type,
+                   handler: ParkedHandler) -> None:
+        self.parked[name] = MethodSpec(name, request_cls, handler)
 
 
 def method(spec: ServiceSpec, request_cls: type):
@@ -139,40 +158,45 @@ def last_server_inner_s() -> Optional[float]:
     return getattr(_tls, "server_inner_s", None)
 
 
-def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:  # ytpu: untrusted(data)
-    """Server-side: decode a request frame, run the handler, encode reply.
+def dispatch_frame_payload(spec: ServiceSpec, name: str, data,
+                           peer: str) -> Payload:  # ytpu: untrusted(data)
+    """Server-side: decode a request frame, run the handler, encode the
+    reply as a gather Payload (the aio front end writes its segments
+    straight to the socket; the joined twin below serves byte-oriented
+    transports).
 
     Never raises: malformed frames, undecodable messages and handler
-    crashes all turn into status frames, so mock:// and grpc:// expose
-    identical failure semantics to callers.
+    crashes all turn into status frames, so mock://, grpc:// and aio://
+    expose identical failure semantics to callers.
     """
     timer = spec.stage_timer
     t0 = _time.perf_counter()
     ms = spec.methods.get(name)
     if ms is None:
-        return encode_frame(STATUS_METHOD_NOT_FOUND, b"")
+        return encode_frame_payload(STATUS_METHOD_NOT_FOUND, b"")
     try:
         # Views, not slices: a multi-MB source attachment reaches the
         # handler without being copied out of the request frame.
         _, meta, attachment = decode_frame_views(data)
         req = ms.request_cls.FromString(meta)
     except Exception as e:
-        return encode_frame(STATUS_TRANSPORT_FAILURE,
-                            f"malformed request: {e!r}".encode())
+        return encode_frame_payload(STATUS_TRANSPORT_FAILURE,
+                                    f"malformed request: {e!r}".encode())
     ctx = RpcContext(peer=peer)
     try:
         resp = ms.handler(req, attachment, ctx)
     except RpcError as e:
-        out = encode_frame(e.status, e.message.encode())
+        out = encode_frame_payload(e.status, e.message.encode())
         _tls.server_inner_s = _time.perf_counter() - t0
         return out
     except Exception as e:
-        out = encode_frame(STATUS_TRANSPORT_FAILURE,
-                           f"handler error: {e!r}".encode())
+        out = encode_frame_payload(STATUS_TRANSPORT_FAILURE,
+                                   f"handler error: {e!r}".encode())
         _tls.server_inner_s = _time.perf_counter() - t0
         return out
     t1 = _time.perf_counter()
-    out = encode_frame(0, resp.SerializeToString(), ctx.response_attachment)
+    out = encode_frame_payload(0, resp.SerializeToString(),
+                               ctx.response_attachment)
     t2 = _time.perf_counter()
     if timer is not None:
         # handler covers request decode too (both are message-codec
@@ -181,6 +205,10 @@ def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> byte
         timer.record(f"{name}:serialize", t2 - t1)
     _tls.server_inner_s = t2 - t0
     return out
+
+
+def dispatch_frame(spec: ServiceSpec, name: str, data: bytes, peer: str) -> bytes:  # ytpu: untrusted(data)
+    return dispatch_frame_payload(spec, name, data, peer).join()
 
 
 # --------------------------------------------------------------------------
@@ -234,8 +262,10 @@ def unregister_mock_server(name: str) -> None:
 class Channel:
     """Client-side channel; scheme-dispatched factory.
 
-    ``Channel("grpc://10.0.0.1:8336")`` or ``Channel("mock://scheduler")``.
-    A bare "host:port" is treated as grpc.
+    ``Channel("grpc://10.0.0.1:8336")``, ``Channel("aio://10.0.0.1:8336")``
+    (the event-loop front end's raw-TCP frame transport) or
+    ``Channel("mock://scheduler")``.  A bare "host:port" is treated as
+    grpc.
     """
 
     def __new__(cls, uri: str):
@@ -245,6 +275,10 @@ class Channel:
         # then runs its __init__ exactly once (do NOT call it here).
         if uri.startswith("mock://"):
             return object.__new__(_MockChannel)
+        if uri.startswith("aio://"):
+            from .aio_server import AioChannel
+
+            return object.__new__(AioChannel)
         from .grpc_transport import GrpcChannel
 
         return object.__new__(GrpcChannel)

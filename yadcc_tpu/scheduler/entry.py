@@ -15,7 +15,7 @@ import threading
 import time
 
 from ..common.token_verifier import make_token_verifier_from_flag
-from ..rpc import GrpcServer
+from ..rpc import make_rpc_server
 from ..utils import exposed_vars
 from ..utils.inspect_server import InspectServer
 from ..utils.logging import get_logger
@@ -40,6 +40,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "device kernel above (the measured winner, "
                         "artifacts/trace_ab.json)")
     p.add_argument("--max-servants", type=int, default=8192)
+    p.add_argument("--rpc-frontend", default="threaded",
+                   choices=["threaded", "aio"],
+                   help="serving front end (doc/scheduler.md \"RPC "
+                        "front end\"): 'threaded' = the grpc thread-"
+                        "pool server (fallback/A-B baseline), 'aio' = "
+                        "the event-loop server — WaitForStartingTask "
+                        "long-polls park as continuations instead of "
+                        "worker threads; delegates/daemons then dial "
+                        "aio://host:port")
     p.add_argument("--shards", type=int, default=1,
                    help="scheduler control-plane shards (doc/scheduler.md "
                         "\"Sharded control plane\"): N>1 partitions the "
@@ -200,15 +209,16 @@ def scheduler_start(args) -> None:
     gc_guard = LatencyGcGuard()
     gc_guard.start()
 
-    server = GrpcServer(f"0.0.0.0:{args.port}")
+    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}")
     server.add_service(service.spec())
     server.start()
-    inspect = InspectServer(args.inspect_port, args.inspect_credential)
+    inspect = InspectServer(args.inspect_port, args.inspect_credential,
+                            frontend=args.rpc_frontend)
     inspect.start()
-    logger.info("scheduler serving on :%d (policy=%s, shards=%d), "
-                "inspect on :%d", args.port,
+    logger.info("scheduler serving on :%d (policy=%s, shards=%d, "
+                "frontend=%s), inspect on :%d", args.port,
                 dispatcher.inspect()["policy"], args.shards,
-                inspect.port)
+                args.rpc_frontend, inspect.port)
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -102,6 +102,16 @@ class SchedulerService:
         s.add("FreeTask", api.scheduler.FreeTaskRequest, self.FreeTask)
         s.add("GetRunningTasks", api.scheduler.GetRunningTasksRequest,
               self.GetRunningTasks)
+        # Parked long-poll twin for the aio front end (doc/scheduler.md
+        # "RPC front end"): a waiting delegate is a pending-table entry
+        # plus the loop's continuation, not a parked worker thread.
+        # Registered only when the dispatcher grew the submit API (the
+        # sharded router routes/steals inside the blocking path and
+        # keeps the worker-pool fallback).
+        if hasattr(self.dispatcher, "submit_wait_for_starting_new_task"):
+            s.add_parked("WaitForStartingTask",
+                         api.scheduler.WaitForStartingTaskRequest,
+                         self.WaitForStartingTaskParked)
         return s
 
     # -- handlers ----------------------------------------------------------
@@ -273,6 +283,59 @@ class SchedulerService:
         for gid, location in grants:
             resp.grants.add(task_grant_id=gid, servant_location=location)
         return resp
+
+    def WaitForStartingTaskParked(self, req, attachment, ctx, done):
+        """Parked-continuation WaitForStartingTask (aio front end).
+
+        Validation, admission ruling and the enqueue run inline on the
+        event loop (all sub-ms, non-blocking); the grant wait itself is
+        a parked pending-table entry whose continuation the completing
+        dispatch thread fires — the response bytes are on the wire two
+        steps after the apply phase, with no waiter-thread wakeup in
+        between.  Semantics (clamps, verdicts, NO_QUOTA on empty) are
+        identical to the blocking handler above."""
+        if not self._user_tokens.verify(req.token):
+            raise RpcError(api.scheduler.SCHEDULER_STATUS_ACCESS_DENIED,
+                           "bad user token")
+        wait_ms = min(req.milliseconds_to_wait or 5000, _MAX_WAIT_MS)
+        lease_ms = min(req.next_keep_alive_in_ms or 15000, _MAX_LEASE_MS)
+        if not req.env_desc.compiler_digest:
+            raise RpcError(api.scheduler.SCHEDULER_STATUS_INVALID_ARGUMENT,
+                           "missing env_desc")
+        decision = self.dispatcher.admission_check(
+            immediate=req.immediate_reqs or 1,
+            prefetch=req.prefetch_reqs,
+            requestor=ctx.peer)
+        if decision.flow != admission.FLOW_NONE:
+            done(api.scheduler.WaitForStartingTaskResponse(
+                flow_control=decision.flow,
+                retry_after_ms=decision.retry_after_ms,
+                degradation_rung=decision.rung))
+            return
+
+        def on_done(grants):
+            if not grants:
+                done(None, error=RpcError(
+                    api.scheduler.SCHEDULER_STATUS_NO_QUOTA_AVAILABLE,
+                    "no capacity for environment"))
+                return
+            resp = api.scheduler.WaitForStartingTaskResponse(
+                degradation_rung=decision.rung)
+            for gid, location in grants:
+                resp.grants.add(task_grant_id=gid,
+                                servant_location=location)
+            done(resp)
+
+        self.dispatcher.submit_wait_for_starting_new_task(
+            req.env_desc.compiler_digest,
+            min_version=max(req.min_version, self._min_version),
+            requestor=ctx.peer,
+            immediate=req.immediate_reqs or 1,
+            prefetch=req.prefetch_reqs if decision.prefetch_allowed else 0,
+            lease_s=lease_ms / 1000.0,
+            timeout_s=wait_ms / 1000.0,
+            on_done=on_done,
+        )
 
     def KeepTaskAlive(self, req, attachment, ctx):
         if not self._user_tokens.verify(req.token):

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -147,6 +147,11 @@ class _Pending:
     prefetch_launched: bool = False
     grants: List[_Grant] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    # Parked-continuation requests (aio front end): called once with
+    # [(grant_id, location)] when the request completes, instead of a
+    # thread blocking on `done`.  Fired OUTSIDE the dispatcher lock by
+    # _fire_async_done().
+    on_done: Optional[Callable] = None
 
 
 class TaskDispatcher:
@@ -232,6 +237,10 @@ class TaskDispatcher:
         self._grant_id_stride = grant_id_stride
 
         self._pending: List[_Pending] = []  # guarded by: self._lock
+        # Completed parked-continuation requests awaiting their
+        # callback fire (drained outside the lock; see
+        # _fire_async_done).
+        self._async_done: List[_Pending] = []  # guarded by: self._lock
         self._stopping = False  # guarded by: self._lock
         self._stats = {"granted": 0, "expired_grants": 0,
                        "zombies_killed": 0}  # guarded by: self._lock
@@ -501,6 +510,117 @@ class TaskDispatcher:
                 self._pending.remove(req)
             return [(g.grant_id, g.servant_location) for g in req.grants]
 
+    def submit_wait_for_starting_new_task(
+        self,
+        env_digest: str,
+        *,
+        min_version: int = 0,
+        requestor: str = "",
+        immediate: int = 1,
+        prefetch: int = 0,
+        lease_s: float = 15.0,
+        timeout_s: float = 5.0,
+        on_done: Callable,
+    ) -> None:
+        """Parked-continuation twin of wait_for_starting_new_task (the
+        aio front end's long-poll path, doc/scheduler.md "RPC front
+        end"): enqueue the request and return immediately; ``on_done``
+        fires exactly once with [(grant_id, servant_location)] — from
+        the completing thread (dispatch cycle, pipelined drain, or the
+        deadline sweep), never under the dispatcher lock.  A parked
+        client costs this pending entry, not a thread.
+
+        The inline-leader fast path applies here exactly as it does to
+        blocking waiters: the submitting thread (the event loop) runs
+        the cycle itself when no cycle is in flight, so an
+        uncontended grant completes — callback fired, response bytes
+        scheduled — within this call, with ZERO thread wakeups.  A
+        cycle is sub-ms at pool scale (the stage budget's
+        dispatch_cycle), which is exactly the latency class an event
+        loop may spend inline; concurrent arrivals coalesce into the
+        leader's cycle or fall back to the dispatch thread."""
+        env_id = self._envs.intern(env_digest)
+        if env_id is None:
+            on_done([])
+            return
+        with self._lock:
+            now = self._clock.now()
+            req = _Pending(
+                env_id=env_id,
+                env_digest=env_digest,
+                min_version=min_version,
+                requestor_slot=self._requestor_slot_locked(requestor),
+                requestor=requestor,
+                lease_s=lease_s,
+                immediate_left=max(0, immediate),
+                prefetch_left=max(0, prefetch),
+                deadline=now + timeout_s,
+                enqueued_at=now,
+                on_done=on_done,
+            )
+            lead = False
+            if req.immediate_left + req.prefetch_left == 0 \
+                    or self._stopping:
+                req = None
+            else:
+                self._pending.append(req)
+                lead = self._inline_dispatch and not self._inline_busy
+                if lead:
+                    self._inline_busy = True
+                else:
+                    self._work.notify_all()
+        if req is None:
+            on_done([])
+            return
+        if lead:
+            # Leading inline: the notify is deferred until we know the
+            # cycle left work behind — waking the dispatch thread just
+            # to find the leader already did everything costs a
+            # context switch on every uncontended grant call.  The
+            # leader DRAINS: requests that arrived mid-cycle (they
+            # could not lead) are served by the leader's next pass
+            # instead of waiting out a dispatch-thread wakeup; the
+            # drain stops when a pass stops producing (capacity-blocked
+            # parked requests belong to the dispatch thread's
+            # deadline machinery, not a spin).
+            try:
+                for _ in range(8):
+                    issued = self._run_cycle()
+                    with self._lock:
+                        more = bool(self._pending)
+                    if not issued or not more:
+                        break
+            except Exception:
+                logger.exception("inline dispatch cycle failed")
+            finally:
+                with self._lock:
+                    self._inline_busy = False
+                    if self._pending:
+                        self._work.notify_all()
+
+    def _fire_async_done(self) -> None:
+        """Deliver completed parked requests' grants to their
+        continuations.  Callbacks run outside the dispatcher lock (they
+        typically hop onto an event loop); abandoned is set first so a
+        racing pipelined drain can never issue into a request whose
+        grants were already reported."""
+        with self._lock:
+            if not self._async_done:
+                return
+            fired, self._async_done = self._async_done, []
+            batches = []
+            for req in fired:
+                req.abandoned = True
+                batches.append((req.on_done,
+                                [(g.grant_id, g.servant_location)
+                                 for g in req.grants]))
+                req.on_done = None
+        for cb, grants in batches:
+            try:
+                cb(grants)
+            except Exception:
+                logger.exception("parked grant continuation failed")
+
     def keep_task_alive(
         self, grant_ids: Sequence[int], next_keep_alive_s: float
     ) -> List[bool]:
@@ -522,7 +642,16 @@ class TaskDispatcher:
                 g = self._grants.get(gid)
                 if g is not None:
                     self._release_grant_locked(g)
-            self._work.notify_all()
+            # Capacity arrival only matters to a parked request; waking
+            # the dispatch thread with nothing pending is a pure
+            # context-switch tax (it costs the serving path its GIL
+            # slice on small hosts, measured by the ISSUE-10 pump rig).
+            # While an inline leader is mid-cycle the wake is deferred
+            # too: the leader re-checks pending on exit and notifies
+            # then, so the capacity cannot be lost — but the dispatch
+            # thread no longer contends for the lock the cycle holds.
+            if self._pending and not self._inline_busy:
+                self._work.notify_all()
 
     def get_running_tasks(self) -> List[_Grant]:
         with self._lock:
@@ -637,6 +766,9 @@ class TaskDispatcher:
         # under the main one): periodic update lets the ladder step
         # down while no requests arrive to drive decide().
         self.admission.update(util, cap, self._clock.now())
+        # Backstop delivery for parked continuations (normally fired by
+        # the cycle that completed them).
+        self._fire_async_done()
 
     # ------------------------------------------------------------------
     # The dispatch cycle.
@@ -771,6 +903,11 @@ class TaskDispatcher:
             if snap is not None:
                 with self._lock:
                     self._release_snapshot_locked(snap)
+            # Parked continuations completed by this cycle fire here —
+            # on the granting thread, right after the apply phase, with
+            # no waiter-thread wakeup in between (the two condvar
+            # handoffs the aio front end exists to delete).
+            self._fire_async_done()
 
     def _try_issue_locked(self, req, is_prefetch: bool, pick: int,
                           snap_generation, cap_cache, now: float,
@@ -915,9 +1052,14 @@ class TaskDispatcher:
                     if self._stopping:
                         break
                     launch = self._select_stream_work_locked()
-                    if launch is None and not tickets:
+                    idle = launch is None and not tickets
+                    if idle and not self._async_done:
                         self._work.wait(timeout=0.1)
-                        continue
+                # Deadline sweeps inside the selection may have
+                # completed parked requests; deliver before continuing.
+                self._fire_async_done()
+                if idle:
+                    continue
                 if launch is None:
                     # Nothing new to launch: finish the oldest in-flight
                     # launch so its waiters wake (blocking here costs
@@ -1118,6 +1260,7 @@ class TaskDispatcher:
             self._finish_satisfied_locked(self._clock.now())
             self._work.notify_all()
         self.stage_timer.record("apply", self._clock.now() - t0)
+        self._fire_async_done()
         return issued
 
     # ------------------------------------------------------------------
@@ -1151,6 +1294,10 @@ class TaskDispatcher:
             if (req.immediate_left <= 0 and not prefetch_pending) \
                     or now >= req.deadline:
                 req.done.set()
+                if req.on_done is not None:
+                    # Parked continuation: queue the fire; the caller's
+                    # unlocked epilogue (_fire_async_done) delivers it.
+                    self._async_done.append(req)
             else:
                 still.append(req)
         self._pending[:] = still
@@ -1378,6 +1525,15 @@ class TaskDispatcher:
         with self._lock:
             self._stopping = True
             self._work.notify_all()
+            # Parked continuations must not dangle past shutdown: hand
+            # each whatever grants it accumulated (usually none).
+            for req in self._pending:
+                if req.on_done is not None:
+                    req.done.set()
+                    self._async_done.append(req)
+            self._pending = [r for r in self._pending
+                             if r.on_done is None]
+        self._fire_async_done()
         if self._thread is not None:
             self._thread.join(timeout=2)
 

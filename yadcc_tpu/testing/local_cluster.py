@@ -35,7 +35,7 @@ from ..daemon.local.running_task_keeper import RunningTaskKeeper
 from ..daemon.local.task_grant_keeper import TaskGrantKeeper
 from ..daemon.sysinfo import LoadAverageSampler
 from ..jit.env import local_jit_environment
-from ..rpc import GrpcServer
+from ..rpc import make_rpc_server
 from ..scheduler.policy import make_policy
 from ..scheduler.service import SchedulerService
 from ..scheduler.task_dispatcher import TaskDispatcher
@@ -87,7 +87,7 @@ class _Servant:
     def __init__(self, cluster: "LocalCluster", tmp: pathlib.Path,
                  index: int, max_concurrency: int,
                  compiler_dirs: List[str]):
-        self.server = GrpcServer("127.0.0.1:0")
+        self.server = make_rpc_server(cluster.rpc_frontend, "127.0.0.1:0")
         config = DaemonConfig(
             scheduler_uri=cluster.sched_uri,
             cache_server_uri=cluster.cache_uri,
@@ -151,7 +151,19 @@ class LocalCluster:
         l2_engine: Optional[CacheEngine] = None,
         http_port: int = 0,
         admission_config=None,
+        # "aio" boots every control-plane server (scheduler, cache,
+        # servants) on the event-loop front end with aio:// dialing,
+        # and the delegate's local HTTP API on the aio HTTP server —
+        # the full-wire rig for ISSUE 10's A/B and e2e tests.
+        # "grpc"/"threaded" is the long-standing default.
+        rpc_frontend: str = "grpc",
+        http_frontend: Optional[str] = None,
     ):
+        self.rpc_frontend = "threaded" if rpc_frontend == "grpc" \
+            else rpc_frontend
+        self._scheme = "aio" if self.rpc_frontend == "aio" else "grpc"
+        http_frontend = http_frontend or (
+            "aio" if self.rpc_frontend == "aio" else "threaded")
         # Single-process rig: self-avoidance must be off, or the
         # requesting machine (ourselves) is never eligible.  `policy`
         # is a name for make_policy, or a ready DispatchPolicy instance
@@ -162,19 +174,23 @@ class LocalCluster:
             pol, max_servants=max(16, n_servants), max_envs=64,
             batch_window_s=0.0, admission_config=admission_config)
         self.sched = SchedulerService(self.sched_dispatcher)
-        self.sched_server = GrpcServer("127.0.0.1:0")
+        self.sched_server = make_rpc_server(self.rpc_frontend,
+                                            "127.0.0.1:0")
         self.sched_server.add_service(self.sched.spec())
         self.sched_server.start()
-        self.sched_uri = f"grpc://127.0.0.1:{self.sched_server.port}"
+        self.sched_uri = \
+            f"{self._scheme}://127.0.0.1:{self.sched_server.port}"
 
         self.cache_service = CacheService(
             InMemoryCache(64 << 20),
             l2_engine if l2_engine is not None else DiskCacheEngine(
                 [ShardSpec(str(tmp / "l2"), 1 << 30)]))
-        self.cache_server = GrpcServer("127.0.0.1:0")
+        self.cache_server = make_rpc_server(self.rpc_frontend,
+                                            "127.0.0.1:0")
         self.cache_server.add_service(self.cache_service.spec())
         self.cache_server.start()
-        self.cache_uri = f"grpc://127.0.0.1:{self.cache_server.port}"
+        self.cache_uri = \
+            f"{self._scheme}://127.0.0.1:{self.cache_server.port}"
 
         self.servants = [
             _Servant(self, tmp, i, servant_concurrency,
@@ -198,6 +214,7 @@ class LocalCluster:
             cache_reader=self.cache_reader,
             running_task_keeper=self.running_keeper,
             cache_writer=self.shim_cache_writer,
+            servant_scheme=f"{self._scheme}://",
         )
         self.http = LocalHttpService(
             monitor=LocalTaskMonitor(nprocs=8, pid_prober=lambda p: True),
@@ -206,6 +223,7 @@ class LocalCluster:
             port=http_port,
             cache_reader=self.cache_reader,
             cache_writer=self.shim_cache_writer,
+            frontend=http_frontend,
         )
         # Background keepers of extra delegates (anything with .stop()).
         self._extra_keepers: List = []
@@ -233,7 +251,8 @@ class LocalCluster:
         self.cache_server.stop(grace=0)
         if down_for_s > 0:
             time.sleep(down_for_s)
-        self.cache_server = GrpcServer(f"127.0.0.1:{port}")
+        self.cache_server = make_rpc_server(self.rpc_frontend,
+                                            f"127.0.0.1:{port}")
         self.cache_server.add_service(self.cache_service.spec())
         self.cache_server.start()
 
@@ -253,6 +272,7 @@ class LocalCluster:
             cache_reader=self.cache_reader,
             running_task_keeper=keeper,
             cache_writer=self.shim_cache_writer,
+            servant_scheme=f"{self._scheme}://",
         )
 
     def stop(self):

@@ -468,6 +468,438 @@ def run(tasks: int, servants: int, concurrency: int, dup_rate: float,
                 os.environ[k] = v
 
 
+# ---------------------------------------------------------------------------
+# Connection-storm mode (--clients N): the ISSUE 10 front-end A/B driver.
+# ---------------------------------------------------------------------------
+
+
+def _read_vm_rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1])
+    return 0
+
+
+async def _read_http_response(reader) -> tuple:
+    """Minimal HTTP/1.1 response read: (status, body)."""
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    length = 0
+    for ln in lines[1:]:
+        if ln.lower().startswith("content-length:"):
+            length = int(ln.split(":", 1)[1])
+    body = await reader.readexactly(length) if length else b""
+    return status, body
+
+
+def _storm_server_main(frontend: str) -> None:
+    """Subprocess entry for the ISOLATED parked-memory measurement: a
+    minimal delegate HTTP front end with a saturated heavy-quota table
+    (every acquire_quota parks for its full window) and nothing else in
+    the process, so /proc/<pid>/status prices exactly what one parked
+    long-poll client costs the SERVER — a thread stack on the threaded
+    front end, a continuation + timer on the aio one."""
+    import sys as _sys
+
+    from ..daemon.local.config_keeper import ConfigKeeper
+    from ..daemon.local.distributed_task_dispatcher import \
+        DistributedTaskDispatcher
+    from ..daemon.local.file_digest_cache import FileDigestCache
+    from ..daemon.local.http_service import LocalHttpService
+    from ..daemon.local.local_task_monitor import LocalTaskMonitor
+    from ..daemon.local.task_grant_keeper import TaskGrantKeeper
+
+    monitor = LocalTaskMonitor(nprocs=2, max_heavy_tasks=1,
+                               pid_prober=lambda p: True)
+    assert monitor.wait_for_running_new_task_permission(1, False, 1.0)
+    svc = LocalHttpService(
+        monitor=monitor, digest_cache=FileDigestCache(),
+        dispatcher=DistributedTaskDispatcher(
+            grant_keeper=TaskGrantKeeper("mock://storm-sched", token=""),
+            config_keeper=ConfigKeeper("mock://storm-sched", token=""),
+            pid_prober=lambda p: True),
+        port=0, frontend=frontend)
+    svc.start()
+    print(f"PORT {svc.port}", flush=True)
+    threading.Event().wait()  # parent kills us
+
+
+def measure_parked_memory(clients: int, frontend: str, *,
+                          ramp_per_s: float = 400.0) -> dict:
+    """Server-side-only memory per parked long-poll client: spawn the
+    minimal front-end subprocess, park `clients` full-window
+    acquire_quota long-polls against it, and read ITS VmRSS before and
+    at the plateau."""
+    import asyncio
+    import signal
+    import subprocess
+    import sys
+
+    from ..rpc.aio_server import EventLoopThread
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from yadcc_tpu.tools.cluster_sim import _storm_server_main; "
+         f"_storm_server_main({frontend!r})"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        port = int(line.split()[1])
+
+        def child_mem_kb() -> tuple:
+            rss = vsz = 0
+            with open(f"/proc/{proc.pid}/status") as f:
+                for ln in f:
+                    if ln.startswith("VmRSS:"):
+                        rss = int(ln.split()[1])
+                    elif ln.startswith("VmSize:"):
+                        vsz = int(ln.split()[1])
+            return rss, vsz
+
+        wait_ms = int((clients / ramp_per_s + 20.0) * 1000)
+        errors = [0]
+
+        async def park(i: int, release: asyncio.Event) -> None:
+            body = (b'{"milliseconds_to_wait": %d, "lightweight_task": '
+                    b'false, "requestor_pid": %d}' % (wait_ms, 2 + i))
+            req = (b"POST /local/acquire_quota HTTP/1.1\r\n"
+                   b"Host: l\r\nContent-Type: application/json\r\n"
+                   b"Content-Length: %d\r\n\r\n" % len(body)) + body
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(req)
+                await writer.drain()
+                await release.wait()
+                writer.close()
+            except Exception:
+                errors[0] += 1
+
+        rss0, vsz0 = child_mem_kb()
+        peak = [0, 0]
+
+        async def drive() -> None:
+            release = asyncio.Event()
+            period = 1.0 / ramp_per_s
+            tasks = []
+            for i in range(clients):
+                tasks.append(asyncio.ensure_future(park(i, release)))
+                await asyncio.sleep(period)
+            await asyncio.sleep(2.0)  # let the server settle
+            peak[0], peak[1] = child_mem_kb()
+            release.set()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        loops = EventLoopThread(name="parked-mem")
+        try:
+            asyncio.run_coroutine_threadsafe(
+                drive(), loops.loop).result(
+                    timeout=clients / ramp_per_s + 120)
+        finally:
+            loops.stop()
+        held = max(1, clients - errors[0])
+        return {
+            "frontend": frontend,
+            "clients": clients,
+            "errors": errors[0],
+            "server_rss_before_kb": rss0,
+            "server_rss_peak_kb": peak[0],
+            # Touched pages per parked client (heap objects + whatever
+            # stack pages the serving model dirties)...
+            "server_kb_per_parked_client": round(
+                max(0, peak[0] - rss0) / held, 2),
+            # ...and reserved address space per parked client: the
+            # threaded front end's 8MB-stack-per-waiter reservation is
+            # the cost the reference's fiber runtime exists to avoid —
+            # RSS alone understates it (stacks are lazily touched).
+            "server_virtual_kb_per_parked_client": round(
+                max(0, peak[1] - vsz0) / held, 1),
+        }
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+
+def run_storm(clients: int, rpc_frontend: str, *, ramp_per_s: float = 300.0,
+              hold_s: float = 8.0, probes_per_s: float = 20.0,
+              compile_tasks: int = 30, compile_s: float = 0.02) -> dict:
+    """Thousands of idle long-poll clients + steady compile traffic
+    against the delegate's local HTTP front end (threaded vs aio — the
+    tentpole's A/B).  Every storm client parks a full-window
+    /local/acquire_quota long-poll against a saturated quota table: on
+    the threaded front end that is one serving THREAD each; on the aio
+    front end, one parked continuation + loop timer each.  Meanwhile
+    probe GETs measure accept responsiveness and a compile stream
+    proves the data path still works.  Reports concurrent_connections,
+    per-connection RSS, accept p50/p99 and the error ledger — the
+    inputs to artifacts/rpc_frontend_ab.json."""
+    import asyncio
+    import http.client
+
+    from ..common.hashing import digest_bytes, digest_file
+    from ..common import compress as _compress
+    from ..common.multi_chunk import make_multi_chunk, try_parse_multi_chunk
+    from ..rpc.aio_server import EventLoopThread
+    from ..testing import LocalCluster, make_fake_compiler
+
+    tmp = Path(tempfile.mkdtemp(prefix="cstorm_"))
+    compiler = make_fake_compiler(str(tmp / "bin"), compile_s=compile_s)
+    compiler_digest = digest_file(compiler)
+    cluster = LocalCluster(
+        tmp, n_servants=2, policy="greedy_cpu", servant_concurrency=2,
+        compiler_dirs=[str(tmp / "bin")],
+        http_frontend=("aio" if rpc_frontend == "aio" else "threaded"))
+    port = cluster.http.port
+    monitor = cluster.http.monitor
+
+    # Saturate the heavy quota class so every storm acquire parks for
+    # its whole window (the long-poll the front end must hold cheaply).
+    heavy_limit = monitor.inspect()["heavy_limit"]
+    for i in range(heavy_limit):
+        assert monitor.wait_for_running_new_task_permission(
+            800000 + i, False, 1.0)
+
+    ramp_s = clients / max(1.0, ramp_per_s)
+    # Every parked client must still be parked when the ramp completes
+    # and the hold window ends (that is the "concurrent" in
+    # concurrent_connections); they all answer 503 at the deadline.
+    wait_ms = int((ramp_s + hold_s + 10.0) * 1000)
+
+    stats_lock = threading.Lock()
+    state = {"connected": 0, "peak": 0, "replies_503": 0,
+             "replies_other": 0, "connect_errors": 0,
+             "response_errors": 0, "lost": 0}
+    accept_lat: list = []
+    probe_errors = [0]
+    rss = {"before": _read_vm_rss_kb(), "peak": 0}
+
+    async def storm_client(i: int) -> None:
+        body = (b'{"milliseconds_to_wait": %d, "lightweight_task": '
+                b'false, "requestor_pid": %d}' % (wait_ms, 900000 + i))
+        req = (b"POST /local/acquire_quota HTTP/1.1\r\n"
+               b"Host: l\r\nContent-Type: application/json\r\n"
+               b"Content-Length: %d\r\n\r\n" % len(body)) + body
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection("127.0.0.1", port), timeout=15.0)
+        except Exception:
+            with stats_lock:
+                state["connect_errors"] += 1
+            return
+        with stats_lock:
+            state["connected"] += 1
+            state["peak"] = max(state["peak"], state["connected"])
+        try:
+            writer.write(req)
+            await writer.drain()
+            status, _ = await asyncio.wait_for(
+                _read_http_response(reader),
+                timeout=wait_ms / 1000.0 + 30.0)
+            with stats_lock:
+                if status == 503:
+                    state["replies_503"] += 1
+                else:
+                    state["replies_other"] += 1
+        except asyncio.TimeoutError:
+            with stats_lock:
+                state["lost"] += 1
+        except Exception:
+            with stats_lock:
+                state["response_errors"] += 1
+        finally:
+            with stats_lock:
+                state["connected"] -= 1
+            writer.close()
+
+    async def prober(stop: asyncio.Event) -> None:
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", port),
+                    timeout=10.0)
+                writer.write(b"GET /local/get_version HTTP/1.1\r\n"
+                             b"Host: l\r\n\r\n")
+                await writer.drain()
+                status, _ = await asyncio.wait_for(
+                    _read_http_response(reader), timeout=10.0)
+                writer.close()
+                if status != 200:
+                    probe_errors[0] += 1
+                else:
+                    accept_lat.append(time.perf_counter() - t0)
+            except Exception:
+                probe_errors[0] += 1
+            try:
+                await asyncio.wait_for(stop.wait(),
+                                       timeout=1.0 / probes_per_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def ramp(stop_probe: asyncio.Event) -> None:
+        tasks = []
+        period = 1.0 / max(1.0, ramp_per_s)
+        for i in range(clients):
+            tasks.append(asyncio.ensure_future(storm_client(i)))
+            await asyncio.sleep(period)
+        # Hold: every client parked at once; sample RSS at the plateau.
+        await asyncio.sleep(hold_s / 2)
+        rss["peak"] = _read_vm_rss_kb()
+        await asyncio.sleep(hold_s / 2)
+        stop_probe.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    # Steady compile traffic on a plain thread (the real client is
+    # synchronous HTTP): submit/wait through the storming front end.
+    compile_lat: list = []
+    compile_failures = [0]
+
+    def compile_stream() -> None:
+        import json as _json
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+
+        def post(path, body):
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+
+        post("/local/set_file_digest", _json.dumps({
+            "file_desc": {"path": compiler, "size": str(
+                os.path.getsize(compiler)), "timestamp": str(int(
+                    os.path.getmtime(compiler)))},
+            "digest": compiler_digest}).encode())
+        deadline = time.monotonic() + ramp_s + hold_s
+        i = 0
+        while time.monotonic() < deadline and not sync_stop.is_set():
+            i += 1
+            src = f"// storm TU {i}\nint f{i}() {{ return {i}; }}\n" \
+                .encode()
+            submit = {
+                "requestor_process_id": 1,
+                "source_path": f"/src/storm{i}.cc",
+                "source_digest": digest_bytes(src),
+                "compiler_invocation_arguments": "-O2",
+                "cache_control": 0,
+                "compiler": {"path": compiler,
+                             "size": str(os.path.getsize(compiler)),
+                             "timestamp": str(int(
+                                 os.path.getmtime(compiler)))},
+            }
+            t0 = time.perf_counter()
+            try:
+                st, data = post("/local/submit_cxx_task",
+                                make_multi_chunk([
+                                    _json.dumps(submit).encode(),
+                                    _compress.compress(src)]))
+                if st != 200:
+                    compile_failures[0] += 1
+                    continue
+                task_id = _json.loads(data)["task_id"]
+                while True:
+                    st, data = post(
+                        "/local/wait_for_cxx_task",
+                        _json.dumps({"task_id": task_id,
+                                     "milliseconds_to_wait": 9000})
+                        .encode())
+                    if st != 503:
+                        break
+                chunks = try_parse_multi_chunk(data) if st == 200 else None
+                if st != 200 or not chunks or \
+                        _json.loads(chunks[0])["exit_code"] != 0:
+                    compile_failures[0] += 1
+                else:
+                    compile_lat.append(time.perf_counter() - t0)
+            except Exception:
+                compile_failures[0] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+            if len(compile_lat) + compile_failures[0] >= compile_tasks:
+                break
+        conn.close()
+
+    sync_stop = threading.Event()
+    loops = EventLoopThread(name="storm-clients")
+    try:
+        t_start = time.perf_counter()
+        compile_thread = threading.Thread(target=compile_stream,
+                                          daemon=True)
+        compile_thread.start()
+        stop_probe_holder = {}
+
+        async def drive():
+            stop_probe = asyncio.Event()
+            stop_probe_holder["ev"] = stop_probe
+            prob = asyncio.ensure_future(prober(stop_probe))
+            await ramp(stop_probe)
+            await prob
+
+        import asyncio as _asyncio
+
+        fut = _asyncio.run_coroutine_threadsafe(drive(), loops.loop)
+        fut.result(timeout=ramp_s + hold_s + wait_ms / 1000.0 + 120)
+        sync_stop.set()
+        compile_thread.join(timeout=60)
+        wall = time.perf_counter() - t_start
+    finally:
+        sync_stop.set()
+        loops.stop()
+        cluster.stop()
+    answered = state["replies_503"] + state["replies_other"]
+    errors = (state["connect_errors"] + state["response_errors"]
+              + state["lost"])
+    acc = (np.array(accept_lat) * 1000.0) if accept_lat else \
+        np.array([0.0])
+    clat = (np.array(compile_lat) * 1000.0) if compile_lat else None
+    per_conn_kb = (max(0, rss["peak"] - rss["before"])
+                   / max(1, state["peak"]))
+    return {
+        "mode": "connection_storm",
+        "frontend": rpc_frontend,
+        "clients": clients,
+        "ramp_per_s": ramp_per_s,
+        "wall_seconds": round(wall, 2),
+        "concurrent_connections": state["peak"],
+        "parked_replies_503": state["replies_503"],
+        "replies_other": state["replies_other"],
+        "connect_errors": state["connect_errors"],
+        "response_errors": state["response_errors"],
+        "lost_or_hung": state["lost"],
+        "error_rate": round(errors / max(1, clients), 4),
+        "rss_before_kb": rss["before"],
+        "rss_peak_kb": rss["peak"],
+        "rss_per_connection_kb": round(per_conn_kb, 1),
+        "accept_probes": int(acc.size),
+        "probe_errors": probe_errors[0],
+        "accept_p50_ms": round(float(np.percentile(acc, 50)), 2),
+        "accept_p99_ms": round(float(np.percentile(acc, 99)), 2),
+        "compile": {
+            "completed": len(compile_lat),
+            "failures": compile_failures[0],
+            "p50_ms": (round(float(np.percentile(clat, 50)), 1)
+                       if clat is not None else None),
+            "p99_ms": (round(float(np.percentile(clat, 99)), 1)
+                       if clat is not None else None),
+        },
+        "_answered": answered,
+    }
+
+
+def quick_storm_concurrent_connections() -> int:
+    """bench.py harness v9 canary: concurrent long-poll connections a
+    small aio-front-end storm sustains with ZERO errors/losses (the
+    in-harness twin of artifacts/rpc_frontend_ab.json's storm arm)."""
+    out = run_storm(200, "aio", ramp_per_s=200.0, hold_s=2.0,
+                    compile_tasks=5, compile_s=0.0)
+    if out["error_rate"] or out["lost_or_hung"]:
+        raise RuntimeError(f"storm quick run failed: {out}")
+    return int(out["concurrent_connections"])
+
+
 def quick_jit_compiles_per_sec() -> float:
     """Small fixed jit-workload run for bench.py's riding-along field:
     end-to-end jit submissions/s through the full loopback farm (fake
@@ -521,6 +953,21 @@ def main() -> int:
                          " or 'byte-heavy' (uniform 128KB..1MB)")
     ap.add_argument("--compile-s", type=float, default=0.05,
                     help="fake compile duration per task (seconds)")
+    ap.add_argument("--clients", type=int, default=0,
+                    help="connection-storm mode (ISSUE 10): park N idle "
+                         "long-poll clients against the local HTTP "
+                         "front end while a compile stream runs; "
+                         "reports concurrent_connections, "
+                         "per-connection RSS and accept p99 "
+                         "(doc/benchmarks.md \"RPC front end\")")
+    ap.add_argument("--rpc-frontend", default="aio",
+                    choices=("threaded", "aio"),
+                    help="which HTTP front end the storm targets "
+                         "(threaded = ThreadingHTTPServer baseline)")
+    ap.add_argument("--storm-ramp", type=float, default=300.0,
+                    help="storm connection ramp, clients/s")
+    ap.add_argument("--storm-hold", type=float, default=8.0,
+                    help="plateau seconds with every client parked")
     ap.add_argument("--scenario", default="",
                     help="run a hostile-world scenario (or 'all') "
                          "instead of the friendly sweep: one of "
@@ -536,6 +983,33 @@ def main() -> int:
                     help="CI gate: small run; exit 1 on any failure or, "
                          "for jit, if dedup never engaged")
     args = ap.parse_args()
+    if args.clients:
+        if args.smoke:
+            args.clients = min(args.clients, 200)
+        out = run_storm(args.clients, args.rpc_frontend,
+                        ramp_per_s=args.storm_ramp,
+                        hold_s=args.storm_hold,
+                        compile_s=0.0 if args.smoke else 0.02)
+        print(json.dumps(out, indent=2))
+        if args.out:
+            Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+        if args.smoke:
+            fails = []
+            if out["lost_or_hung"]:
+                fails.append(f"{out['lost_or_hung']} lost/hung clients")
+            if out["error_rate"] > 0:
+                fails.append(f"error rate {out['error_rate']}")
+            if out["accept_p99_ms"] > 250.0:
+                fails.append(
+                    f"accept p99 {out['accept_p99_ms']}ms > 250ms")
+            if out["compile"]["failures"]:
+                fails.append(
+                    f"{out['compile']['failures']} compile failures "
+                    f"under storm")
+            if fails:
+                print("SMOKE FAILED: " + "; ".join(fails))
+                return 1
+        return 0
     if args.scenario:
         from . import scenarios
 

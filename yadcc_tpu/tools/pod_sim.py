@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import argparse
 import heapq
+import math
 import json
 import queue
 import threading
@@ -134,7 +135,7 @@ class PodSim:
                  pumps: Optional[int] = None, hb_interval: float = 0.5,
                  mesh_loads: str = "auto", check_unique: bool = False,
                  arrival_rate: float = 0.0, pump_batch: int = 128,
-                 steal_batch: int = 64):
+                 steal_batch: int = 64, frontend: str = "mock"):
         from ..cache.cache_engine import NullCacheEngine
         from ..cache.in_memory_cache import InMemoryCache
         from ..cache.service import CacheService
@@ -163,6 +164,19 @@ class PodSim:
         # scheduler's stage percentiles.
         self.arrival_rate = arrival_rate
         self.pump_batch = max(1, pump_batch)
+        # Pump-rig pacing: aggregate grant-call rate across pumps
+        # (0 = flood).  A latency instrument must run BELOW saturation
+        # or it measures queueing, not the path.
+        self.rig_call_rate = 0.0
+        self._pump_phase_seq = 0  # guarded by: self.need_lock
+        # Pump-rig mode: the pump itself frees its grants and returns
+        # the demand (no binder/free thread = no GIL ping-pong per
+        # grant batch on the measured path).
+        self._rig_inline_free = False
+        # Whole-fleet heartbeat sweeps are phase-spread in chunks this
+        # big; the latency rig shrinks them so a sweep burst never
+        # holds the GIL across a grant round trip.
+        self._hb_chunk = 256
         self.router = None
         if self.shards == 1:
             # ~12% slot headroom over the fleet, rounded to 256 (churn
@@ -217,23 +231,45 @@ class PodSim:
         self._ServantInfo = ServantInfo
 
         # The grant path goes through the production RPC service: real
-        # handlers, real message/frame codec, in-process transport.
+        # handlers, real message/frame codec, in-process transport —
+        # or, with --frontend grpc|aio, over real loopback sockets
+        # through the matching server (the ISSUE 10 front-end A/B:
+        # "grpc" is the threaded baseline, "aio" the event-loop path
+        # with WaitForStartingTask parked; doc/benchmarks.md "RPC
+        # front end").
         self.service = SchedulerService(self.dispatcher)
-        self._mock_name = f"podsim-{id(self):x}"
-        register_mock_server(self._mock_name, self.service.spec())
-        self.sched_channel = Channel(
-            f"mock://{self._mock_name}@10.255.0.1:9")
-        # Synthetic delegate identities: each its own channel so the
-        # observed RPC peer — the router's consistent-hash routing key
-        # — is a real, distinct delegate address (servants live in
-        # 10.0/16; delegates in 10.254/16).
+        self.frontend = frontend
         self.n_delegates = max(1, delegates)
         self.n_pumps = pumps if pumps else max(1, self.shards)
-        self.delegate_channels = [
-            Channel(f"mock://{self._mock_name}"
-                    f"@10.254.{d >> 8 & 255}.{d & 255}:7")
-            for d in range(self.n_delegates)
-        ]
+        self._mock_name = f"podsim-{id(self):x}"
+        self._rpc_server = None
+        self.delegate_channels: Optional[list] = None
+        if frontend == "mock":
+            register_mock_server(self._mock_name, self.service.spec())
+            # Synthetic delegate identities: each its own channel so
+            # the observed RPC peer — the router's consistent-hash
+            # routing key — is a real, distinct delegate address
+            # (servants live in 10.0/16; delegates in 10.254/16).
+            self.delegate_channels = [
+                Channel(f"mock://{self._mock_name}"
+                        f"@10.254.{d >> 8 & 255}.{d & 255}:7")
+                for d in range(self.n_delegates)
+            ]
+        else:
+            from ..rpc import make_rpc_server
+
+            self._rpc_server = make_rpc_server(
+                "aio" if frontend == "aio" else "threaded",
+                "127.0.0.1:0")
+            self._rpc_server.add_service(self.service.spec())
+            self._rpc_server.start()
+            if frontend == "grpc":
+                self.delegate_channels = [
+                    Channel(f"grpc://127.0.0.1:{self._rpc_server.port}")
+                    for _ in range(self.n_delegates)
+                ]
+            # aio: AsyncAioChannels are created ON the client loop by
+            # the pump coroutines (run()).
         self._hotspot_cdf = parse_hotspot(hotspot, self.n_delegates)
         # Unique-grant-id oracle (the stolen-grant never-double-issued
         # invariant): smoke/test rigs flip check_unique on; production-
@@ -364,7 +400,7 @@ class PodSim:
                 if self._stop.wait(self.hb_interval):
                     return
                 continue
-            chunk = 256
+            chunk = self._hb_chunk
             pause = self.hb_interval * chunk / len(locs)
             for i in range(0, len(locs), chunk):
                 for loc in locs[i:i + chunk]:
@@ -432,7 +468,30 @@ class PodSim:
         from ..rpc import transport as rpc_transport
 
         rng = random.Random(threading.get_ident() ^ id(self))
+        period = (self.n_pumps / self.rig_call_rate
+                  if self.rig_call_rate > 0 else 0.0)
+        # Phase-spread across pumps, EQUALLY: paced pumps with fixed
+        # periods keep their relative phases all run long, so two
+        # pumps that start near each other collide on every single
+        # call (the whole run's p50 doubles) — deterministic 1/N
+        # spacing is the only clustering-free assignment.
+        with self.need_lock:
+            pump_idx = self._pump_phase_seq
+            self._pump_phase_seq += 1
+        next_at = time.monotonic() + period * pump_idx / max(
+            1, self.n_pumps)
         while not self._stop.is_set():
+            if period > 0.0:
+                ahead = next_at - time.monotonic()
+                if ahead > 0:
+                    time.sleep(ahead)
+                next_at += period
+                behind = time.monotonic() - next_at
+                if behind > 0:
+                    # Overran: skip the missed slots but KEEP the 1/N
+                    # phase — resetting to "now" would let this pump
+                    # drift into a permanent collision with another.
+                    next_at += period * math.ceil(behind / period)
             with self.need_lock:
                 n = min(self.need, self.pump_batch)
                 if n > 0:
@@ -479,8 +538,15 @@ class PodSim:
                         if gid in self._seen_gids:
                             self._dup_gids += 1
                         self._seen_gids.add(gid)
-            for g in got:
-                self.grants.put(g)
+            if self._rig_inline_free and got:
+                # Pump-rig recycle: free on the spot, return the
+                # demand — no per-grant queue handoff to a free thread.
+                self.dispatcher.free_task([gid for gid, _ in got])
+                with self.need_lock:
+                    self.need += len(got)
+            else:
+                for g in got:
+                    self.grants.put(g)
 
     def _demand_monitor(self) -> None:
         """~20Hz per-shard demand sampler (outstanding grants + queued
@@ -527,6 +593,96 @@ class PodSim:
             "client_backlog_p50": int(np.percentile(backlog, 50)),
             "client_backlog_peak": int(backlog.max()),
         }
+
+    async def _grant_pump_async(self, channels: dict) -> None:
+        """Event-loop twin of _grant_pump (--frontend aio): each pump
+        is a coroutine on the client loop, so hundreds of them cost no
+        thread stacks and their outstanding calls pipeline over one
+        persistent connection per delegate identity.  The server side
+        parks each request as a continuation (WaitForStartingTaskParked)
+        — grant_call here prices the whole parked round trip."""
+        import asyncio
+        import random
+        import time as _t
+
+        from .. import api
+        from ..rpc import RpcError
+        from ..rpc.aio_server import AsyncAioChannel
+
+        rng = random.Random()
+        target = f"127.0.0.1:{self._rpc_server.port}"
+        period = (self.n_pumps / self.rig_call_rate
+                  if self.rig_call_rate > 0 else 0.0)
+        # Equal 1/N phase spacing — see _grant_pump: with fixed
+        # periods, randomly-clustered phases collide on EVERY call for
+        # the whole run.
+        with self.need_lock:
+            pump_idx = self._pump_phase_seq
+            self._pump_phase_seq += 1
+        next_at = _t.monotonic() + period * pump_idx / max(
+            1, self.n_pumps)
+        while not self._stop.is_set():
+            if period > 0.0:
+                ahead = next_at - _t.monotonic()
+                if ahead > 0:
+                    await asyncio.sleep(ahead)
+                next_at += period
+                behind = _t.monotonic() - next_at
+                if behind > 0:
+                    # Overran: skip missed slots, KEEP the 1/N phase
+                    # (see _grant_pump).
+                    next_at += period * math.ceil(behind / period)
+            with self.need_lock:
+                n = min(self.need, self.pump_batch)
+                if n > 0:
+                    self.need -= n          # reserve
+            if n <= 0:
+                await asyncio.sleep(0.0005)
+                continue
+            d = self._pick_delegate(rng)
+            chan = channels.get(d)
+            if chan is None:
+                # call() dials under the channel's own lock, so pumps
+                # racing on a fresh delegate identity share one socket.
+                chan = channels[d] = AsyncAioChannel(target)
+            req = api.scheduler.WaitForStartingTaskRequest(
+                token="", immediate_reqs=n,
+                milliseconds_to_wait=250, next_keep_alive_in_ms=15000)
+            req.env_desc.compiler_digest = self.env
+            t0 = _t.perf_counter()
+            try:
+                resp, _ = await chan.call(
+                    "ytpu.SchedulerService", "WaitForStartingTask", req,
+                    api.scheduler.WaitForStartingTaskResponse,
+                    timeout=10.0)
+                got = [(g.task_grant_id, g.servant_location)
+                       for g in resp.grants]
+                stolen = int(resp.stolen_grants)
+            except RpcError:
+                got, stolen = [], 0  # NO_QUOTA (timeout w/o capacity)
+            total = _t.perf_counter() - t0
+            self.grant_lat_ms.append(total * 1000.0)
+            self.client_timer.record("grant_call", total)
+            with self.need_lock:
+                self.need += n - len(got)   # return unserved demand
+                self.grant_calls += 1
+                self.grants_granted += len(got)
+                self.grants_stolen += stolen
+            if self._check_unique and got:
+                with self._gid_lock:
+                    for gid, _ in got:
+                        if gid in self._seen_gids:
+                            self._dup_gids += 1
+                        self._seen_gids.add(gid)
+            if self._rig_inline_free and got:
+                # Pump-rig recycle: free on the spot, return the
+                # demand — no per-grant queue handoff to a free thread.
+                self.dispatcher.free_task([gid for gid, _ in got])
+                with self.need_lock:
+                    self.need += len(got)
+            else:
+                for g in got:
+                    self.grants.put(g)
 
     def _dispatch(self, comp: _Completion) -> None:
         """Register demand for `comp`; the binder marries it to a grant
@@ -677,6 +833,133 @@ class PodSim:
         self._dispatch(comp)
         return "run"
 
+    # -- pump rig (grant-path latency instrument) ----------------------------
+
+    def run_pump_rig(self, calls: int, demand: int,
+                     call_rate: float = 0.0,
+                     time_limit_s: float = 300.0,
+                     warmup_s: float = 2.0) -> dict:
+        """The grant-path latency instrument (ISSUE 10): steady grant
+        demand through the full RPC front end with NOTHING else on the
+        box — no synthetic build clients, no cache fills, no completion
+        heap.  pod_sim's full runs co-host a whole build farm in this
+        process, so their grant_call tails price the farm's GIL holds,
+        not the serving path; production runs those clients on other
+        machines.  The rig A/Bs front ends apples-to-apples: same
+        demand, same fleet, only the transport/parking model changes
+        (artifacts/rpc_frontend_ab.json)."""
+        import sys as _sys
+
+        from ..utils import gctune
+
+        # The rig owns its GIL slice policy (callers may not have gone
+        # through main()): long co-tenant slices land straight in the
+        # client-observed tail on a 1-core box.
+        prev_switch = _sys.getswitchinterval()
+        _sys.setswitchinterval(0.0002)
+        self.rig_call_rate = call_rate
+        self._rig_inline_free = True
+        # Chunks BELOW the dispatcher's staged-heartbeat flush
+        # threshold (64): a chunk that hits it makes the hb thread
+        # flush synchronously under the main lock — a periodic lock
+        # hold the latency instrument would bill to the serving path
+        # (cycles flush the small staged batches instead, under the
+        # lock they already hold).
+        self._hb_chunk = 16
+        with self.need_lock:
+            self.need = demand
+        loops = [(self._heartbeat_loop, "hb")]
+        if self.frontend != "aio":
+            loops += [(self._grant_pump, f"grants-{i}")
+                      for i in range(self.n_pumps)]
+        threads = [threading.Thread(target=f, daemon=True, name=n)
+                   for f, n in loops]
+        pump_futs = None
+        pump_channels: dict = {}
+        if self.frontend == "aio":
+            import asyncio
+
+            pump_futs = [
+                asyncio.run_coroutine_threadsafe(
+                    self._grant_pump_async(pump_channels),
+                    self._rpc_server.loops.loop)
+                for _ in range(self.n_pumps)
+            ]
+        with gctune.guard():
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            warm_cut = None
+            while True:
+                with self.need_lock:
+                    done = self.grant_calls
+                if warm_cut is None and \
+                        time.perf_counter() - t0 >= warmup_s:
+                    # Channel dials, first-cycle jit of nothing-in-
+                    # particular, allocator warmup: the first seconds
+                    # measure the rig settling, not the path.
+                    warm_cut = len(self.grant_lat_ms)
+                if done >= calls or \
+                        time.perf_counter() - t0 > time_limit_s:
+                    break
+                time.sleep(0.05)
+            wall = time.perf_counter() - t0
+        self._stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if pump_futs is not None:
+            for f in pump_futs:
+                try:
+                    f.result(timeout=5)
+                except Exception:
+                    pass
+            self._rpc_server.loops.call_soon(
+                lambda: [c.close() for c in pump_channels.values()])
+        self.dispatcher.stop()
+        if self._rpc_server is not None:
+            self._rpc_server.stop()
+        if self.frontend == "mock":
+            from ..rpc import unregister_mock_server
+
+            unregister_mock_server(self._mock_name)
+        _sys.setswitchinterval(prev_switch)
+        measured = self.grant_lat_ms[warm_cut or 0:]
+        lat = np.array(measured) if measured else np.array([0.0])
+        disp = self.dispatcher.inspect()
+        disp_lat = disp["latency_breakdown"]
+        svc_lat = self.service.stage_timer.percentiles()
+        frontend_stages = (self._rpc_server.stage_timer.percentiles()
+                          if self._rpc_server is not None
+                          and hasattr(self._rpc_server, "stage_timer")
+                          else None)
+        return {
+            "mode": "pump_rig",
+            "warmup_s": warmup_s,
+            "measured_calls": int(lat.size),
+            "frontend": self.frontend,
+            "servants": len(self.servant_running),
+            "demand": demand,
+            "call_rate": call_rate,
+            "grant_calls_per_sec": round(self.grant_calls / wall, 1),
+            "pumps": self.n_pumps,
+            "pump_batch": self.pump_batch,
+            "wall_seconds": round(wall, 2),
+            "grant_calls": int(self.grant_calls),
+            "grants_granted": int(self.grants_granted),
+            "assignments_per_sec": round(self.grants_granted / wall, 1),
+            "grant_call_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "grant_call_p99_ms": round(float(np.percentile(lat, 99)), 2),
+            "latency_breakdown": {
+                "queue_wait_ms": disp_lat.get("queue_wait"),
+                "dispatch_cycle_ms": disp_lat.get("dispatch_cycle"),
+                "rpc_handler_ms": svc_lat.get(
+                    "WaitForStartingTask:handler"),
+                "rpc_serialize_ms": svc_lat.get(
+                    "WaitForStartingTask:serialize"),
+                "frontend_stages": frontend_stages,
+            },
+        }
+
     # -- run -----------------------------------------------------------------
 
     def run(self, tasks: int, dup_rate: float,
@@ -696,10 +979,32 @@ class PodSim:
                  (self._completion_loop, "complete"),
                  (self._binder_loop, "binder"),
                  (self._replica_loop, "bloom")]
-        loops += [(self._grant_pump, f"grants-{i}")
-                  for i in range(self.n_pumps)]
+        if self.frontend != "aio":
+            loops += [(self._grant_pump, f"grants-{i}")
+                      for i in range(self.n_pumps)]
         if self.router is not None:
             loops.append((self._demand_monitor, "demand"))
+        pump_loop = pump_futs = None
+        pump_channels: dict = {}
+        if self.frontend == "aio":
+            # All pumps are coroutines on ONE loop — the server's: N
+            # pumps cost N coroutine frames, not N thread stacks, their
+            # calls pipeline over per-delegate persistent connections,
+            # and the whole grant round trip (send -> parse -> parked
+            # handler -> inline cycle -> write -> response) runs with
+            # ZERO thread handoffs, the fiber model this front end
+            # reproduces.  (In production client and scheduler are
+            # different machines; co-hosting the pump coroutines on the
+            # scheduler's loop is the 1-core rig's closest analogue
+            # that doesn't bill OS thread scheduling to the wire.)
+            import asyncio
+
+            pump_loop = self._rpc_server.loops
+            pump_futs = [
+                asyncio.run_coroutine_threadsafe(
+                    self._grant_pump_async(pump_channels), pump_loop.loop)
+                for _ in range(self.n_pumps)
+            ]
         threads = [threading.Thread(target=f, daemon=True, name=n)
                    for f, n in loops]
         work = queue.Queue()
@@ -756,11 +1061,22 @@ class PodSim:
             self.ev_cv.notify_all()
         for t in threads:
             t.join(timeout=10)
+        if pump_futs is not None:
+            for f in pump_futs:
+                try:
+                    f.result(timeout=5)
+                except Exception:
+                    pass
+            pump_loop.call_soon(
+                lambda: [c.close() for c in pump_channels.values()])
+            # The loop is the rpc server's; its stop() below owns it.
         self.dispatcher.stop()
+        if self._rpc_server is not None:
+            self._rpc_server.stop()
+        if self.frontend == "mock":
+            from ..rpc import unregister_mock_server
 
-        from ..rpc import unregister_mock_server
-
-        unregister_mock_server(self._mock_name)
+            unregister_mock_server(self._mock_name)
         lat = np.array(self.grant_lat_ms) if self.grant_lat_ms else \
             np.array([0.0])
         disp = self.dispatcher.inspect()
@@ -806,7 +1122,15 @@ class PodSim:
                 "mesh_loads": disp.get("mesh_loads"),
                 "per_shard": per_shard,
             }
+        # aio front end: the server's accept/read/parse/write stages
+        # (rpc/aio_server.py's StageTimer) make the residual transport
+        # time attributable instead of a lump.
+        frontend_stages = (self._rpc_server.stage_timer.percentiles()
+                          if self._rpc_server is not None
+                          and hasattr(self._rpc_server, "stage_timer")
+                          else None)
         return {
+            "frontend": self.frontend,
             "tasks": int(done),
             "servants": len(self.servant_running),
             "servant_capacity": self.capacity,
@@ -846,6 +1170,7 @@ class PodSim:
                     "WaitForStartingTask:serialize"),
                 "transport_ms": client_lat.get("transport"),
                 "grant_call_ms": client_lat.get("grant_call"),
+                "frontend_stages": frontend_stages,
             },
             # The BASELINE "<2ms dispatch" budget: scheduler-side work
             # per cycle (snapshot + policy + apply), excluding the
@@ -875,7 +1200,8 @@ def run_one(args, *, shards: int, hotspot: Optional[str], steal: bool,
                  check_unique=check_unique,
                  arrival_rate=args.arrival_rate,
                  pump_batch=args.pump_batch,
-                 steal_batch=args.steal_batch)
+                 steal_batch=args.steal_batch,
+                 frontend=getattr(args, "frontend", "mock"))
     return sim.run(tasks, args.dup_rate, args.submitters)
 
 
@@ -1092,6 +1418,37 @@ def quick_sharded_assignments_per_sec() -> float:
     return float(out["assignments_per_sec"])
 
 
+def run_pump_rig_one(args) -> dict:
+    sim = PodSim(args.servants, args.capacity, args.policy,
+                 0.0, args.churn_per_s,
+                 capacity_dist=args.capacity_dist,
+                 shards=args.shards,
+                 delegates=args.delegates,
+                 pumps=args.pumps or 4,
+                 hb_interval=args.hb_interval,
+                 mesh_loads="off",
+                 pump_batch=args.pump_batch,
+                 frontend=args.frontend)
+    return sim.run_pump_rig(args.rig_calls, args.rig_demand,
+                            call_rate=args.rig_rate)
+
+
+def quick_aio_grant_call_p99_ms() -> float:
+    """bench.py harness v9 canary: grant_call p99 through the aio
+    front end (parked WaitForStartingTask, coroutine pumps, real
+    loopback sockets) on a small single-dispatcher pump rig — the
+    in-harness twin of artifacts/rpc_frontend_ab.json's pod_sim
+    section."""
+    ap = build_arg_parser()
+    args = ap.parse_args([
+        "--servants", "256", "--capacity", "8", "--policy", "greedy_cpu",
+        "--churn-per-s", "0", "--pumps", "4", "--pump-batch", "16",
+        "--hb-interval", "2.0", "--frontend", "aio",
+        "--rig-calls", "4000", "--rig-demand", "128", "--rig-rate", "400",
+    ])
+    return float(run_pump_rig_one(args)["grant_call_p99_ms"])
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser("ytpu-pod-sim")
     ap.add_argument("--tasks", type=int, default=50000)
@@ -1142,6 +1499,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     choices=["auto", "off"],
                     help="device-sharded cross-shard load summary "
                          "(parallel/mesh.py:shard_load_summary_fn)")
+    ap.add_argument("--frontend", default="mock",
+                    choices=["mock", "grpc", "aio"],
+                    help="grant-call transport: 'mock' = in-process "
+                         "(the PR-2 rig), 'grpc' = the threaded server "
+                         "over real loopback sockets, 'aio' = the "
+                         "event-loop front end with parked "
+                         "WaitForStartingTask and coroutine pumps "
+                         "(doc/benchmarks.md \"RPC front end\")")
+    ap.add_argument("--pump-rig", action="store_true",
+                    help="grant-path latency instrument: steady grant "
+                         "demand (see --rig-demand) through the chosen "
+                         "--frontend with no synthetic build clients "
+                         "co-hosted, reporting grant_call percentiles "
+                         "(the rpc_frontend_ab.json rig)")
+    ap.add_argument("--rig-calls", type=int, default=20000,
+                    help="pump-rig: grant calls to record")
+    ap.add_argument("--rig-demand", type=int, default=256,
+                    help="pump-rig: steady outstanding grant demand")
+    ap.add_argument("--rig-rate", type=float, default=0.0,
+                    help="pump-rig: aggregate grant calls/s across "
+                         "pumps (0 = flood; a latency claim needs a "
+                         "below-saturation rate)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: small sharded hotspot run with "
                          "invariant assertions (exit 1 on violation)")
@@ -1199,7 +1578,9 @@ def main() -> None:
                 f"{args.shards}").strip()
     if args.smoke:
         sys.exit(smoke(args))
-    if args.ab:
+    if args.pump_rig:
+        out = run_pump_rig_one(args)
+    elif args.ab:
         out = run_ab(args)
     else:
         out = run_one(args, shards=args.shards, hotspot=args.hotspot,

@@ -1,0 +1,328 @@
+"""RPC front-end A/B: byte parity + the ISSUE-10 artifact driver.
+
+Two jobs:
+
+* ``--parity-smoke`` (CI gate, tools/ci.sh): drive one corpus of
+  requests — the dataplane smoke shapes (empty/small/64KB/1MB metas and
+  attachments), unknown methods, handler errors, malformed inner
+  frames — through the SAME ServiceSpec mounted on both the grpc
+  thread-pool server and the aio event-loop server, and require the
+  raw reply *frames* to be byte-identical.  The HTTP twin drives the
+  same POST bodies through the threaded and aio LocalHttpService and
+  requires identical (status, body) pairs.  Exit 2 on any divergence —
+  parity, never speed.
+
+* default / ``--out`` (the artifact, artifacts/rpc_frontend_ab.json):
+  the three ISSUE-10 targets measured on this box —
+
+  1. connection storm (cluster_sim.run_storm): threaded at its
+     baseline scale vs aio at >=10x the connections, equal error rate;
+  2. parked-wait memory per idle client
+     (cluster_sim.measure_parked_memory, isolated server subprocess):
+     touched RSS and reserved address space per parked long-poll;
+  3. pod_sim pump rig (pod_sim.run_pump_rig): grant_call p50/p99
+     through the threaded (grpc) vs aio front ends over real loopback
+     sockets, best-of-N (this repo's bench convention), with the
+     <1.5ms aio p99 target (vs 2.97ms in artifacts/pod_sim_100k.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def _make_blob(size: int, seed: int = 7) -> bytes:
+    # Deterministic compressible-ish bytes (the dataplane corpus
+    # shape): repeated tokens with per-line variation.
+    chunk = b"".join(b"tok%d;" % (i % 97) for i in range(256))
+    out = (chunk * (size // len(chunk) + 1))[:size]
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# parity smoke
+# ---------------------------------------------------------------------------
+
+
+def _parity_service():
+    from .. import api
+    from ..rpc import RpcContext, RpcError, ServiceSpec
+
+    spec = ServiceSpec("ytpu.ParityProbe")
+
+    def echo(req, attachment, ctx: RpcContext):
+        ctx.response_attachment = bytes(attachment) + b"|echo"
+        return api.scheduler.GetConfigResponse(
+            serving_daemon_token="parity:" + req.token)
+
+    def fail_app(req, attachment, ctx):
+        raise RpcError(1234, "app failure, deterministically")
+
+    def crash(req, attachment, ctx):
+        raise ValueError("handler crash, deterministically")
+
+    spec.add("Echo", api.scheduler.GetConfigRequest, echo)
+    spec.add("FailApp", api.scheduler.GetConfigRequest, fail_app)
+    spec.add("Crash", api.scheduler.GetConfigRequest, crash)
+    return spec
+
+
+def run_parity_smoke() -> int:
+    """Returns 0 on byte parity, 2 on divergence (the CI contract)."""
+    from .. import api
+    from ..rpc import Channel, GrpcServer
+    from ..rpc.aio_server import AioRpcServer
+    from ..rpc.transport import encode_frame
+
+    spec = _parity_service()
+    grpc_srv = GrpcServer("127.0.0.1:0")
+    grpc_srv.add_service(spec)
+    grpc_srv.start()
+    aio_srv = AioRpcServer("127.0.0.1:0")
+    aio_srv.add_service(spec)
+    g = Channel(f"grpc://127.0.0.1:{grpc_srv.port}")
+    a = Channel(f"aio://127.0.0.1:{aio_srv.port}")
+    failures = []
+    try:
+        corpus = []
+        for size in (0, 1, 4096, 64 << 10, 1 << 20):
+            req = api.scheduler.GetConfigRequest(token=f"sz{size}")
+            corpus.append(("Echo", encode_frame(
+                0, req.SerializeToString(), _make_blob(size))))
+        req = api.scheduler.GetConfigRequest(token="x")
+        meta = req.SerializeToString()
+        corpus.append(("FailApp", encode_frame(0, meta)))
+        corpus.append(("Crash", encode_frame(0, meta)))
+        corpus.append(("NoSuchMethod", encode_frame(0, meta)))
+        # Malformed inner frame: claims more meta than the frame holds.
+        corpus.append(("Echo", b"\x00\x00\x00\x00\xff\xff\x00\x00abc"))
+        for i, (method, frame) in enumerate(corpus):
+            via_grpc = bytes(g.call_raw("ytpu.ParityProbe", method,
+                                        frame, timeout=30))
+            via_aio = bytes(a.call_raw("ytpu.ParityProbe", method,
+                                       frame, timeout=30))
+            if via_grpc != via_aio:
+                failures.append(
+                    f"frame corpus[{i}] {method}: grpc reply "
+                    f"{len(via_grpc)}B != aio reply {len(via_aio)}B")
+    finally:
+        a.close()
+        g.close()
+        aio_srv.stop()
+        grpc_srv.stop(grace=0)
+    failures += _http_parity()
+    if failures:
+        for f in failures:
+            print(f"PARITY DIVERGENCE: {f}", file=sys.stderr)
+        return 2
+    print(json.dumps({"parity_smoke": "ok",
+                      "frame_corpus": 9, "http_corpus": 7}))
+    return 0
+
+
+def _http_parity() -> list:
+    """Same POST/GET corpus through threaded and aio LocalHttpService;
+    (status, body) must match exactly (headers carry incidentals like
+    Date on the threaded server and are not part of the contract)."""
+    import http.client
+
+    from ..daemon.local.config_keeper import ConfigKeeper
+    from ..daemon.local.distributed_task_dispatcher import \
+        DistributedTaskDispatcher
+    from ..daemon.local.file_digest_cache import FileDigestCache
+    from ..daemon.local.http_service import LocalHttpService
+    from ..daemon.local.local_task_monitor import LocalTaskMonitor
+    from ..daemon.local.task_grant_keeper import TaskGrantKeeper
+
+    def build(frontend: str):
+        d = DistributedTaskDispatcher(
+            grant_keeper=TaskGrantKeeper("mock://parity-sched", token=""),
+            config_keeper=ConfigKeeper("mock://parity-sched", token=""),
+            pid_prober=lambda p: True)
+        svc = LocalHttpService(
+            monitor=LocalTaskMonitor(nprocs=4, pid_prober=lambda p: True),
+            digest_cache=FileDigestCache(), dispatcher=d, port=0,
+            frontend=frontend)
+        svc.start()
+        return svc, d
+
+    corpus = [
+        ("GET", "/local/get_version", b""),
+        ("GET", "/local/nope", b""),
+        ("POST", "/local/acquire_quota",
+         b'{"milliseconds_to_wait": 100, "lightweight_task": true, '
+         b'"requestor_pid": 77}'),
+        ("POST", "/local/release_quota", b'{"requestor_pid": 77}'),
+        ("POST", "/local/wait_for_cxx_task",
+         b'{"task_id": "424242", "milliseconds_to_wait": 50}'),
+        ("POST", "/local/submit_cxx_task", b"not-multi-chunk"),
+        ("POST", "/local/jit_cache_get", b'{"key": "k"}'),
+    ]
+
+    def drive(svc) -> list:
+        out = []
+        for method, path, body in corpus:
+            conn = http.client.HTTPConnection("127.0.0.1", svc.port,
+                                              timeout=30)
+            conn.request(method, path, body=body or None, headers={
+                "Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            out.append((resp.status, resp.read()))
+            conn.close()
+        return out
+
+    failures = []
+    threaded, d1 = build("threaded")
+    aio, d2 = build("aio")
+    try:
+        got_t = drive(threaded)
+        got_a = drive(aio)
+        for (method, path, _), t, na in zip(corpus, got_t, got_a):
+            if t != na:
+                failures.append(
+                    f"http {method} {path}: threaded {t[0]} "
+                    f"{t[1][:60]!r} != aio {na[0]} {na[1][:60]!r}")
+    finally:
+        threaded.stop()
+        aio.stop()
+        d1.stop()
+        d2.stop()
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# the artifact
+# ---------------------------------------------------------------------------
+
+
+def run_ab(args) -> dict:
+    from .cluster_sim import measure_parked_memory, run_storm
+    from .pod_sim import PodSim
+
+    # 1. Connection storm: the threaded baseline at a scale it can
+    # sustain cleanly, the aio front end at >=10x the connections.
+    print(f"== connection storm: threaded x{args.storm_base} ==",
+          flush=True)
+    storm_threaded = run_storm(args.storm_base, "threaded",
+                               ramp_per_s=args.storm_ramp,
+                               hold_s=args.storm_hold)
+    print(f"== connection storm: aio x{args.storm_base * 10} ==",
+          flush=True)
+    storm_aio = run_storm(args.storm_base * 10, "aio",
+                          ramp_per_s=args.storm_ramp * 4,
+                          hold_s=args.storm_hold)
+
+    # 2. Parked-wait memory, isolated server subprocess.
+    print("== parked-wait memory (isolated server) ==", flush=True)
+    mem = {fe: measure_parked_memory(args.mem_clients, fe,
+                                     ramp_per_s=600.0)
+           for fe in ("threaded", "aio")}
+
+    # 3. Pump rig: grant_call latency, best-of-N per front end.
+    def rig(frontend: str) -> dict:
+        best = None
+        for i in range(args.rig_runs):
+            print(f"== pump rig {frontend} run {i + 1}/{args.rig_runs} "
+                  f"==", flush=True)
+            sim = PodSim(args.rig_servants, 8, "greedy_cpu", 0.0, 2,
+                         pumps=4, hb_interval=2.0, mesh_loads="off",
+                         pump_batch=16, frontend=frontend)
+            out = sim.run_pump_rig(args.rig_calls, 128,
+                                   call_rate=args.rig_rate)
+            if best is None or out["grant_call_p99_ms"] < \
+                    best["grant_call_p99_ms"]:
+                best = dict(out, runs=i + 1)
+        return best
+
+    rig_aio = rig("aio")
+    rig_grpc = rig("grpc")
+
+    rss_ratio = (mem["threaded"]["server_kb_per_parked_client"]
+                 / max(0.01, mem["aio"]["server_kb_per_parked_client"]))
+    vsz_ratio = (mem["threaded"]["server_virtual_kb_per_parked_client"]
+                 / max(0.01,
+                       mem["aio"]["server_virtual_kb_per_parked_client"]))
+    conn_ratio = (storm_aio["concurrent_connections"]
+                  / max(1, storm_threaded["concurrent_connections"]))
+    return {
+        "metric": "rpc_frontend_ab",
+        "connection_storm": {
+            "threaded": storm_threaded,
+            "aio": storm_aio,
+            "concurrent_connections_ratio": round(conn_ratio, 1),
+            "equal_error_rate": (storm_aio["error_rate"]
+                                 == storm_threaded["error_rate"]),
+        },
+        "parked_memory": {
+            **mem,
+            "rss_per_client_ratio": round(rss_ratio, 1),
+            "virtual_per_client_ratio": round(vsz_ratio, 1),
+        },
+        "pump_rig": {
+            "aio": rig_aio,
+            "threaded_grpc": rig_grpc,
+            "baseline_grant_call_p99_ms_pr2": 2.97,
+        },
+        "targets": {
+            "concurrent_connections_10x": bool(
+                conn_ratio >= 10.0
+                and storm_aio["error_rate"]
+                <= storm_threaded["error_rate"]),
+            "grant_call_p99_under_1_5ms": bool(
+                rig_aio["grant_call_p99_ms"] < 1.5),
+            "parked_memory_20x": bool(vsz_ratio >= 20.0),
+        },
+        "_meta": {
+            "rig": "1-core container; pump-rig latency is best-of-N "
+                   "(repo bench convention) at a paced below-"
+                   "saturation call rate; parked memory is measured "
+                   "against an isolated server subprocess so the "
+                   "storm driver's own buffers are not billed to the "
+                   "front end.  The >=20x parked-memory target is met "
+                   "on reserved address space (the threaded front "
+                   "end's 8MB thread stacks — the cost the reference's "
+                   "fiber runtime avoids); touched-RSS ratio is "
+                   "published alongside.",
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("ytpu-rpc-frontend-bench")
+    ap.add_argument("--parity-smoke", action="store_true",
+                    help="byte-parity gate only (CI); exit 2 on "
+                         "divergence")
+    ap.add_argument("--storm-base", type=int, default=500,
+                    help="threaded-arm storm clients (aio runs 10x)")
+    ap.add_argument("--storm-ramp", type=float, default=250.0)
+    ap.add_argument("--storm-hold", type=float, default=8.0)
+    ap.add_argument("--mem-clients", type=int, default=1000)
+    ap.add_argument("--rig-servants", type=int, default=256)
+    ap.add_argument("--rig-calls", type=int, default=8000)
+    ap.add_argument("--rig-rate", type=float, default=400.0)
+    ap.add_argument("--rig-runs", type=int, default=5)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+    if args.parity_smoke:
+        return run_parity_smoke()
+    out = run_ab(args)
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+    return 0 if all(out["targets"].values()) else 1
+
+
+if __name__ == "__main__":
+    import os
+
+    # Quiet logs + scheduler-class priority, as pod_sim's main does.
+    os.environ.setdefault("YTPU_LOG_LEVEL", "WARNING")
+    try:
+        os.setpriority(os.PRIO_PROCESS, 0, -10)
+    except (OSError, AttributeError):
+        pass
+    sys.exit(main())

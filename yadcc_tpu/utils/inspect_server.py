@@ -40,18 +40,52 @@ class _Handler(BaseHTTPRequestHandler):
 
 class InspectServer:
     def __init__(self, port: int = 0, credential: str = "",
-                 host: str = "127.0.0.1"):
-        handler = type("BoundHandler", (_Handler,),
-                       {"auth": InspectAuth(credential)})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
-        self.port = self._httpd.server_port
+                 host: str = "127.0.0.1", frontend: str = "threaded"):
+        self._auth = InspectAuth(credential)
+        if frontend == "aio":
+            # Event-loop front end (--rpc-frontend aio): /inspect rides
+            # the same loop discipline as the serving path; a dump is
+            # quick but may call arbitrary exposed callables, so it
+            # runs on the bounded pool, not the loop.
+            from ..rpc.aio_server import AioHttpServer
+
+            self._httpd = None
+            self._aio = AioHttpServer(self._handle_aio,
+                                      address=f"{host}:{port}")
+            self.port = self._aio.port
+        else:
+            self._aio = None
+            handler = type("BoundHandler", (_Handler,),
+                           {"auth": self._auth})
+            self._httpd = ThreadingHTTPServer((host, port), handler)
+            self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
+    def _handle_aio(self, responder) -> None:
+        if responder.method != "GET" or \
+                not responder.path.startswith("/inspect/vars"):
+            responder._reply(404, content_type="text/plain")
+            return
+        if not self._auth.check(responder.headers.get("authorization")):
+            responder._reply(401, content_type="text/plain")
+            return
+        prefix = responder.path[len("/inspect/vars"):].strip("/")
+
+        def dump() -> None:
+            responder._reply(200, exposed_vars.dump_json(prefix).encode())
+
+        self._aio.submit(dump)
+
     def start(self) -> None:
+        if self._aio is not None:
+            return
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="inspect", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        if self._aio is not None:
+            self._aio.stop()
+            return
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -19,6 +19,16 @@ from typing import Dict, Iterable, Optional
 
 import numpy as np
 
+# The serving front end's transport stages (rpc/aio_server.py records
+# them; pod_sim surfaces them as `latency_breakdown.frontend_stages`):
+# `accept` = connection open -> first complete request, `read` = first
+# byte of a request -> the byte completing it, `parse` = incremental
+# decode CPU, `write` = response gather-write to the transport.  With
+# these, the residual grant_call time that used to lump into
+# "queue-wait/transport" is attributable stage by stage
+# (doc/scheduler.md "Grant-path stage budget").
+FRONTEND_STAGES = ("accept", "read", "parse", "write")
+
 
 class _Reservoir:
     """Fixed-size ring of the most recent samples plus a total count."""
