@@ -1399,3 +1399,51 @@ def test_aio_package_is_clean():
         [os.path.join(PKG_DIR, "rpc")], AnalyzerConfig())
     assert not live(findings, "aio-blocking"), \
         [f.message for f in live(findings, "aio-blocking")]
+
+
+# ---------------------------------------------------------------------------
+# device-sync (dispatcher-cycle device readbacks)
+# ---------------------------------------------------------------------------
+
+
+DEVICE_SYNC_SNIPPET = """
+    import numpy as np
+    import jax
+
+    def cycle(picks, pool):
+        out = np.asarray(picks)
+        jax.block_until_ready(pool)
+        picks.block_until_ready()
+        got = jax.device_get(pool)
+        ok = np.asarray(  # ytpu: allow(device-sync)  # oracle sync
+            pool.alive)
+        return out, got, ok
+"""
+
+
+def test_device_sync_family(tmp_path):
+    findings, _ = run_snippet(
+        tmp_path, DEVICE_SYNC_SNIPPET,
+        device_sync_path_fragments=("mod.py",))
+    hits = live(findings, "device-sync")
+    assert len(hits) == 4, [f.message for f in hits]
+    # The annotated readback is suppressed, not live.
+    sup = [f for f in findings
+           if f.rule == "device-sync" and f.suppressed]
+    assert len(sup) == 1
+
+
+def test_device_sync_scoped_to_dispatcher_modules(tmp_path):
+    # Default scope is by module filename; a random scheduler module
+    # named mod.py stays out.
+    findings, _ = run_snippet(tmp_path, DEVICE_SYNC_SNIPPET)
+    assert not live(findings, "device-sync")
+
+
+def test_dispatcher_modules_are_clean():
+    """The shipped dispatcher cycle must satisfy its own rule: every
+    device readback in it is an annotated, sanctioned sync point."""
+    findings, _ = analyze_paths(
+        [os.path.join(PKG_DIR, "scheduler")], AnalyzerConfig())
+    assert not live(findings, "device-sync"), \
+        [(f.path, f.line) for f in live(findings, "device-sync")]

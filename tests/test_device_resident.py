@@ -1,0 +1,394 @@
+"""Device-resident dispatch: the pool lives on the accelerator.
+
+The tentpole invariant, tested at every layer it crosses:
+
+* the fused step (scatter delta -> running fold -> grouped assignment
+  -> in-kernel grant delta) must place grants exactly like
+  greedy_assign_reference run over the same host state — across
+  capacity distributions, chained over many steps, counts and picks
+  twins alike;
+* DeviceResidentPool's delta protocol must survive churn storms —
+  joins, leaves, capacity/version flips, delta overflow, lost dirty
+  tracking — with the statics oracle reporting bit-parity and the
+  escalations (full re-syncs) counted, never silent;
+* the stale-stream guard: an epoch that moves BACKWARD under a live
+  chain raises (caller bug), an unseeded/wrong-width chain auto-resyncs
+  with a counter;
+* the router-scope mesh launch (ONE sharded step for N shards) must
+  match N independent local resident steps bit-for-bit, on both the
+  device-expansion and counts routes.
+
+Parity is per-run multisets: within a run of identical requests the
+threshold search may permute picks; the grant multiset and the final
+running array are the contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yadcc_tpu.models.cost import DEFAULT_COST_MODEL
+from yadcc_tpu.ops import assignment as asn
+from yadcc_tpu.ops import assignment_grouped as asg
+from yadcc_tpu.scheduler.device_pool import DeviceResidentPool
+from yadcc_tpu.scheduler.policy import (JaxResidentGroupedPolicy,
+                                        PoolSnapshot)
+
+CM = DEFAULT_COST_MODEL
+
+
+def capacity_sampler(dist, rng, s):
+    if dist == "fixed":
+        return np.full(s, 4, np.int32)
+    if dist == "uniform":
+        return rng.integers(1, 9, s).astype(np.int32)
+    if dist == "bimodal":
+        return np.where(rng.random(s) < 0.2, 16, 2).astype(np.int32)
+    raise ValueError(dist)
+
+
+def make_host_pool(rng, s, dist="uniform", e_words=4):
+    cap = capacity_sampler(dist, rng, s)
+    return {
+        "alive": rng.random(s) < 0.85,
+        "capacity": cap,
+        "running": np.minimum(
+            rng.integers(0, 8, s), cap).astype(np.int32),
+        "dedicated": rng.random(s) < 0.3,
+        "version": rng.integers(1, 4, s).astype(np.int32),
+        "env_bitmap": rng.integers(
+            0, 2**32, (s, e_words), dtype=np.uint64).astype(np.uint32),
+    }
+
+
+def to_device_pool(host):
+    return asn.PoolArrays(
+        alive=jnp.asarray(host["alive"]),
+        capacity=jnp.asarray(host["capacity"]),
+        running=jnp.asarray(host["running"]),
+        dedicated=jnp.asarray(host["dedicated"]),
+        version=jnp.asarray(host["version"]),
+        env_bitmap=jnp.asarray(host["env_bitmap"]),
+    )
+
+
+def statics_of(host):
+    return {k: host[k] for k in ("alive", "capacity", "dedicated",
+                                 "version", "env_bitmap")}
+
+
+def churn_slots(rng, host, n):
+    """Random statics churn on n slots; returns the dirty index list."""
+    s = len(host["alive"])
+    dirty = sorted(rng.choice(s, size=min(n, s), replace=False).tolist())
+    for i in dirty:
+        kind = rng.integers(0, 4)
+        if kind == 0:
+            host["alive"][i] = not host["alive"][i]
+        elif kind == 1:
+            host["capacity"][i] = rng.integers(1, 12)
+        elif kind == 2:
+            host["version"][i] = rng.integers(1, 5)
+        else:
+            host["env_bitmap"][i, rng.integers(
+                0, host["env_bitmap"].shape[1])] = rng.integers(0, 2**32)
+    return dirty
+
+
+def random_descr(rng, s, n_groups):
+    """Distinct run descriptors (a repeated key would be one run to the
+    dispatcher but two to this rig's bookkeeping)."""
+    descr = []
+    for g in range(n_groups):
+        descr.append((int(rng.integers(0, 63)) * 2 + (g & 1),
+                      int(rng.integers(1, 4)),
+                      int(rng.integers(-1, s)),
+                      int(rng.integers(1, 12))))
+    return descr
+
+
+def reference_step(host, descr, adj, rmask, rval):
+    """Host twin of the fused step: fold, then the sequential oracle
+    (mutates host['running'] exactly like the kernel's grant delta)."""
+    host["running"] = np.where(
+        rmask, rval, np.maximum(host["running"] + adj, 0)
+    ).astype(np.int32)
+    tasks = []
+    for env, mv, req, cnt in descr:
+        tasks.extend([(env, mv, req)] * cnt)
+    return asn.greedy_assign_reference(host, tasks, CM)
+
+
+def assert_run_multisets(descr, got, want):
+    off = 0
+    for env, mv, req, cnt in descr:
+        assert sorted(got[off:off + cnt]) == sorted(want[off:off + cnt]), (
+            f"run (env={env}, n={cnt}) multiset diverges: "
+            f"{sorted(got[off:off + cnt])} vs {sorted(want[off:off + cnt])}")
+        off += cnt
+
+
+class TestFusedStepVsOracle:
+    """resident_grouped_step chained across cycles == the sequential
+    oracle, per capacity distribution, deltas and folds included."""
+
+    @pytest.mark.parametrize("dist", ["fixed", "uniform", "bimodal"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_chained_steps_match(self, dist, seed):
+        rng = np.random.default_rng(100 * seed + hash(dist) % 97)
+        s = 96
+        host = make_host_pool(rng, s, dist)
+        pool = to_device_pool(host)
+        for step in range(6):
+            dirty = churn_slots(rng, host, int(rng.integers(0, 6)))
+            delta = asg.make_pool_delta(
+                np.asarray(dirty, np.int64), statics_of(host),
+                pad_to=asg.delta_pad(len(dirty)), pool_size=s)
+            adj = np.zeros(s, np.int32)
+            adj[rng.choice(s, 8, replace=False)] = rng.integers(
+                -2, 3, 8)
+            rmask = np.zeros(s, bool)
+            rval = np.zeros(s, np.int32)
+            for slot in rng.choice(s, 2, replace=False):
+                rmask[slot] = True
+                rval[slot] = rng.integers(0, 4)
+            descr = random_descr(rng, s, int(rng.integers(1, 5)))
+            total = sum(d[3] for d in descr)
+            t_pad = asg.task_pad(total)
+            packed = asg.make_grouped_packed(
+                descr, pad_to=asg.group_pad(len(descr)))
+            picks_dev, pool = asg.resident_grouped_step(
+                pool, delta, packed, jnp.asarray(adj),
+                jnp.asarray(rmask), jnp.asarray(rval), t_pad, CM)
+            got = np.asarray(picks_dev)[:total].tolist()
+            want = reference_step(host, descr, adj, rmask, rval)
+            assert_run_multisets(descr, got, want)
+            assert np.array_equal(np.asarray(pool.running),
+                                  host["running"]), f"step {step}"
+
+    def test_counts_twin_matches_picks(self):
+        """The host-platform counts twin grants the same (group, slot)
+        multiset the picks kernel expands on device."""
+        rng = np.random.default_rng(7)
+        s = 64
+        host = make_host_pool(rng, s, "uniform")
+        descr = random_descr(rng, s, 3)
+        total = sum(d[3] for d in descr)
+        packed = asg.make_grouped_packed(
+            descr, pad_to=asg.group_pad(len(descr)))
+        empty = asg.make_pool_delta(
+            np.zeros(0, np.int64), statics_of(host),
+            pad_to=asg.delta_pad(0), pool_size=s)
+        z = jnp.zeros(s, jnp.int32)
+        zb = jnp.zeros(s, bool)
+        picks_dev, p1 = asg.resident_grouped_step(
+            to_device_pool(host), empty, packed, z, zb, z,
+            asg.task_pad(total), CM)
+        counts_dev, p2 = asg.resident_grouped_step_counts(
+            to_device_pool(host), empty, packed, z, zb, z, CM)
+        picks = np.asarray(picks_dev)
+        counts = np.asarray(counts_dev)
+        off = 0
+        for gi, (_, _, _, cnt) in enumerate(descr):
+            run = [p for p in picks[off:off + cnt] if p != asn.NO_PICK]
+            from_counts = np.repeat(
+                np.arange(s), counts[gi, :s]).tolist()
+            assert sorted(run) == from_counts
+            off += cnt
+        assert np.array_equal(np.asarray(p1.running),
+                              np.asarray(p2.running))
+
+
+class TestDevicePoolChurnStorm:
+    """DeviceResidentPool.step under sustained churn: delta scatters
+    keep the resident statics bit-identical to the host snapshot, and
+    the two escalation paths (delta overflow, lost dirty tracking) are
+    counted full re-syncs, not corruption."""
+
+    def _snap(self, host):
+        return PoolSnapshot(
+            alive=host["alive"], capacity=host["capacity"],
+            running=host["running"], dedicated=host["dedicated"],
+            version=host["version"], env_bitmap=host["env_bitmap"])
+
+    def test_churn_storm_parity(self):
+        rng = np.random.default_rng(31)
+        s = 80
+        host = make_host_pool(rng, s, "uniform")
+        rp = DeviceResidentPool(CM, use_pallas=False,
+                                oracle_interval=10**9)
+        rp.seed(self._snap(host))
+        for step in range(30):
+            if step == 11:
+                # Lost dirty tracking: dirty=None must escalate to a
+                # counted full statics re-sync.
+                churn_slots(rng, host, 3)
+                dirty = None
+            elif step == 19:
+                # Delta overflow: a churn storm past the pad ladder's
+                # break-even (> s/8 slots) re-uploads wholesale.
+                dirty = churn_slots(rng, host, s // 4)
+            else:
+                dirty = churn_slots(rng, host, int(rng.integers(0, 5)))
+            adj = np.zeros(s, np.int32)
+            adj[rng.choice(s, 6, replace=False)] = rng.integers(-2, 3, 6)
+            resets = {int(i): int(rng.integers(0, 3))
+                      for i in rng.choice(s, 2, replace=False)}
+            descr = random_descr(rng, s, int(rng.integers(1, 4)))
+            total = sum(d[3] for d in descr)
+            picks = rp.step(self._snap(host), dirty, descr, adj, resets,
+                            asg.task_pad(total))
+            got = np.asarray(picks)[:total].tolist()
+            rmask = np.zeros(s, bool)
+            rval = np.zeros(s, np.int32)
+            for slot, val in resets.items():
+                rmask[slot], rval[slot] = True, val
+            want = reference_step(host, descr, adj, rmask, rval)
+            assert_run_multisets(descr, got, want)
+            assert np.array_equal(np.asarray(rp.running),
+                                  host["running"]), f"step {step}"
+            assert rp.oracle_check(self._snap(host)), f"step {step}"
+        stats = rp.inspect()
+        assert stats["full_syncs"] == 2          # steps 11 and 19
+        assert stats["oracle_mismatches"] == 0
+        assert stats["delta_launches"] == 30
+        assert stats["seeds"] == 1
+
+    def test_oracle_repairs_drift(self):
+        """A mismatch (simulated lost scatter) is detected, counted,
+        and REPAIRED — the next check passes from re-synced state."""
+        rng = np.random.default_rng(5)
+        s = 32
+        host = make_host_pool(rng, s, "fixed")
+        rp = DeviceResidentPool(CM, use_pallas=False,
+                                oracle_interval=10**9)
+        rp.seed(self._snap(host))
+        host["capacity"][3] += 2     # churn the device never hears about
+        assert not rp.oracle_check(self._snap(host))
+        assert rp.inspect()["oracle_mismatches"] == 1
+        assert rp.inspect()["full_syncs"] == 1
+        assert rp.oracle_check(self._snap(host))
+
+
+class TestStaleStreamGuard:
+    def _snap(self, s=32, epoch=-1):
+        return PoolSnapshot(
+            alive=np.ones(s, bool),
+            capacity=np.full(s, 4, np.int32),
+            running=np.zeros(s, np.int32),
+            dedicated=np.zeros(s, bool),
+            version=np.ones(s, np.int32),
+            env_bitmap=np.full((s, 4), 0xFFFFFFFF, np.uint32),
+            epoch=epoch)
+
+    def test_epoch_regression_raises(self):
+        pol = JaxResidentGroupedPolicy(max_groups=4, use_pallas=False)
+        pol.stream_begin(self._snap(epoch=5))
+        with pytest.raises(ValueError, match="epoch moved backward"):
+            pol.stream_launch(self._snap(epoch=4), [(0, 0, -1, 1)],
+                              np.zeros(32, np.int32), {}, dirty=())
+
+    def test_epoch_advance_rides_deltas(self):
+        pol = JaxResidentGroupedPolicy(max_groups=4, use_pallas=False)
+        pol.stream_begin(self._snap(epoch=5))
+        pol.stream_launch(self._snap(epoch=7), [(0, 0, -1, 1)],
+                          np.zeros(32, np.int32), {}, dirty=())
+        assert pol.stream_stats()["resyncs"] == 0
+        assert pol.stream_stats()["epoch"] == 7
+
+    def test_unseeded_chain_auto_resyncs_counted(self):
+        pol = JaxResidentGroupedPolicy(max_groups=4, use_pallas=False)
+        pol.stream_launch(self._snap(epoch=3), [(0, 0, -1, 1)],
+                          np.zeros(32, np.int32), {}, dirty=())
+        stats = pol.stream_stats()
+        assert stats["resyncs"] == 1
+        assert stats["seeds"] >= 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4,
+                    reason="needs >= 4 devices (conftest forces 8)")
+class TestMeshOneLaunchParity:
+    """resident_control_plane_step_fn: ONE sharded launch over N shard
+    slices == N independent local fused steps — picks route and counts
+    route alike (the router's _fused_expand_on_device trade)."""
+
+    N, PER = 4, 32
+
+    def _rig(self, seed=13):
+        from yadcc_tpu.parallel import mesh as pmesh
+
+        rng = np.random.default_rng(seed)
+        mesh = pmesh.make_mesh(self.N)
+        hosts = [make_host_pool(rng, self.PER, "uniform")
+                 for _ in range(self.N)]
+        descrs = [random_descr(rng, self.PER, 2) for _ in range(self.N)]
+        dirties = [churn_slots(rng, h, 3) for h in hosts]
+        return pmesh, mesh, hosts, descrs, dirties
+
+    def _stacked_inputs(self, hosts, descrs, dirties, g_pad, d_pad):
+        n, per = self.N, self.PER
+        packed = np.stack([
+            np.asarray(asg.make_grouped_packed(d, pad_to=g_pad))
+            for d in descrs])
+        deltas = [asg.make_pool_delta(
+            np.asarray(di, np.int64), statics_of(h), pad_to=d_pad,
+            pool_size=per) for h, di in zip(hosts, dirties)]
+        delta = asg.PoolDelta(*(jnp.stack([jnp.asarray(getattr(d, f))
+                                           for d in deltas])
+                                for f in asg.PoolDelta._fields))
+        z = jnp.zeros(n * per, jnp.int32)
+        return jnp.asarray(packed), delta, z, jnp.zeros(n * per, bool), z
+
+    def _cat_pool(self, pmesh, mesh, hosts):
+        cat = {k: np.concatenate([h[k] for h in hosts])
+               for k in hosts[0]}
+        return jax.tree.map(jax.device_put, to_device_pool(cat),
+                            pmesh.pool_sharding(mesh))
+
+    def test_one_launch_matches_local_steps(self):
+        pmesh, mesh, hosts, descrs, dirties = self._rig()
+        g_pad = max(asg.group_pad(len(d)) for d in descrs)
+        d_pad = max(asg.delta_pad(len(di)) for di in dirties)
+        totals = [sum(d[3] for d in descrs[k]) for k in range(self.N)]
+        t_max = max(asg.task_pad(t) for t in totals)
+        packed, delta, adj, rmask, rval = self._stacked_inputs(
+            hosts, descrs, dirties, g_pad, d_pad)
+
+        fn = pmesh.resident_control_plane_step_fn(mesh, t_max, CM)
+        picks, pool = fn(self._cat_pool(pmesh, mesh, hosts), delta,
+                         packed, adj, rmask, rval)
+        picks = np.asarray(picks)
+        fused_running = np.asarray(pool.running)
+
+        cfn = pmesh.resident_control_plane_step_fn(
+            mesh, t_max, CM, return_picks=False)
+        counts, cpool = cfn(self._cat_pool(pmesh, mesh, hosts), delta,
+                            packed, adj, rmask, rval)
+        counts = np.asarray(counts)
+        assert np.array_equal(fused_running, np.asarray(cpool.running))
+
+        per, z = self.PER, jnp.zeros(self.PER, jnp.int32)
+        for k in range(self.N):
+            local_delta = asg.make_pool_delta(
+                np.asarray(dirties[k], np.int64), statics_of(hosts[k]),
+                pad_to=d_pad, pool_size=per)
+            lp, lpool = asg.resident_grouped_step(
+                to_device_pool(hosts[k]), local_delta,
+                asg.make_grouped_packed(descrs[k], pad_to=g_pad),
+                z, jnp.zeros(per, bool), z, t_max, CM)
+            assert np.array_equal(picks[k], np.asarray(lp)), f"shard {k}"
+            assert np.array_equal(fused_running[k * per:(k + 1) * per],
+                                  np.asarray(lpool.running)), f"shard {k}"
+            # Counts route: same grant multiset per run.
+            off = 0
+            for gi, (_, _, _, cnt) in enumerate(descrs[k]):
+                run = sorted(p for p in picks[k][off:off + cnt]
+                             if p != asn.NO_PICK)
+                from_counts = np.repeat(
+                    np.arange(per), counts[k, gi, :per]).tolist()
+                assert run == from_counts, f"shard {k} run {gi}"
+                off += cnt

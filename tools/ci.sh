@@ -131,6 +131,18 @@ if ! python -m yadcc_tpu.tools.pod_sim --shards 4 --smoke; then
   fail=1
 fi
 
+echo "== device-resident dispatch smoke =="
+# Device-resident control-plane gate (doc/scheduler.md "Device-resident
+# dispatch"): a 4-shard fused run where every cycle's picks are checked
+# against greedy_assign_reference on the launch snapshot, the resident
+# running slices must match the host-replayed fold, no grant id is
+# double-issued, and the statics oracle (interval=1) reports zero
+# mismatches.  Gates on PARITY, never on speed.
+if ! python -m yadcc_tpu.tools.pod_sim --device-resident --smoke; then
+  echo "device-resident pod_sim smoke FAILED" >&2
+  fail=1
+fi
+
 echo "== chaos smoke (hostile-world scenario gates) =="
 # Robustness gates (doc/robustness.md): a flaky servant must not cost
 # a single task (survival via retries + local fallback), and the

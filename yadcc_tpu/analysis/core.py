@@ -45,6 +45,8 @@ RULES: Dict[str, str] = {
                      "@jax.jit function",
     "jit-static-unhashable": "unhashable value bound to a static jit "
                              "argument",
+    "device-sync": "host-blocking device sync (np.asarray / device_get "
+                   "/ block_until_ready) in a dispatcher-cycle module",
     "taint-alloc": "allocation / read sized by an untrusted integer "
                    "without a size-cap sanitizer",
     "taint-wait": "untrusted value controls a timeout/wait duration "
@@ -153,10 +155,20 @@ class AnalyzerConfig:
     # legitimately does I/O under its own lock and stays out).
     hot_path_fragments: Tuple[str, ...] = ("scheduler", "daemon")
     # Path fragments selecting the modules where jit hygiene applies.
-    jit_path_fragments: Tuple[str, ...] = ("ops", "parallel")
+    # device_pool.py rides along: it is the scheduler-side owner of the
+    # jitted resident step and its static-arg discipline.
+    jit_path_fragments: Tuple[str, ...] = ("ops", "parallel",
+                                           "device_pool.py")
     # Path fragments selecting the modules where aio-blocking applies
     # (the event-loop front end: coroutines there must never block).
     aio_path_fragments: Tuple[str, ...] = ("rpc",)
+    # Path fragments (filename parts) selecting the dispatcher-cycle
+    # modules where device-sync applies: the device-resident dispatch
+    # hot loop, where any unsanctioned np.asarray/block_until_ready
+    # stalls the fused launch pipeline.
+    device_sync_path_fragments: Tuple[str, ...] = (
+        "device_pool.py", "shard_router.py", "policy.py",
+        "task_dispatcher.py")
     # Lock hierarchy: canonical lock name -> rank (lower acquired
     # first).  Loaded from lock_hierarchy.toml by the CLI.
     lock_ranks: Dict[str, int] = field(default_factory=dict)
@@ -174,6 +186,7 @@ class AnalyzerConfig:
         return {"hot": list(self.hot_path_fragments),
                 "jit": list(self.jit_path_fragments),
                 "aio": list(self.aio_path_fragments),
+                "dsync": list(self.device_sync_path_fragments),
                 "ranks": dict(self.lock_ranks)}
 
 
@@ -823,7 +836,8 @@ def analyze_paths(paths: Sequence[str],
     import hashlib
     import time as _time
 
-    from . import jit_hygiene, lifecycle, lockrules, taint, wirecompat
+    from . import (device_sync, jit_hygiene, lifecycle, lockrules, taint,
+                   wirecompat)
 
     config = config or AnalyzerConfig()
     files = _collect_py_files(paths)
@@ -888,6 +902,8 @@ def analyze_paths(paths: Sequence[str],
             raw.extend(_timed("lockrules", lockrules.check_module,
                               rec.model, config))
             raw.extend(_timed("jit-hygiene", jit_hygiene.check_module,
+                              rec.model, config))
+            raw.extend(_timed("device-sync", device_sync.check_module,
                               rec.model, config))
             raw.extend(_timed("lifecycle", lifecycle.check_module,
                               rec.model, config, acquires_names))

@@ -1,0 +1,66 @@
+"""Rule family 7: host-blocking device syncs in dispatcher-cycle modules.
+
+The device-resident control plane keeps the pool arrays on the
+accelerator across dispatch cycles; the whole point is that a cycle
+issues ONE launch and reads back only the picks, asynchronously.  A
+single accidental synchronous readback — ``np.asarray(device_value)``,
+``jax.device_get``, ``.block_until_ready()`` — re-serializes the
+pipeline: the host stalls on the PCIe/ICI round trip every cycle and
+the fused launch degenerates back into the host-loop it replaced.
+
+``device-sync`` flags every such call in the dispatcher-cycle modules
+(config.device_sync_path_fragments — filename parts, so the scope is
+per-module, not per-package).  The check is syntactic: it cannot prove
+the operand lives on the device, so host-side uses (``np.asarray`` over
+a Python list, the sanctioned apply-boundary collect, the periodic
+equivalence oracle) are expected and carry a written
+``# ytpu: allow(device-sync)  # reason`` on the call line — the
+pragma inventory IS the audit trail of sanctioned sync points.
+Like the lock rules: false positives surface for a human decision,
+silent false negatives are the failure mode we refuse.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import AnalyzerConfig, Finding, ModuleModel, _dotted
+from .lockrules import _in_scope
+
+# Dotted call names that force a device->host transfer (or a full
+# device fence) when handed a device value.
+_SYNC_DOTTED = {
+    "np.asarray": "np.asarray",
+    "numpy.asarray": "numpy.asarray",
+    "np.array": "np.array",
+    "numpy.array": "numpy.array",
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+}
+
+
+def check_module(model: ModuleModel,
+                 config: AnalyzerConfig) -> List[Finding]:
+    if not _in_scope(model.relpath, config.device_sync_path_fragments):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted in _SYNC_DOTTED:
+            findings.append(Finding(
+                "device-sync", model.relpath, node.lineno,
+                f"{_SYNC_DOTTED[dotted]} in a dispatcher-cycle module "
+                f"blocks on device->host transfer when given a device "
+                f"value; keep the hot loop async or annotate the "
+                f"sanctioned sync point"))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"):
+            findings.append(Finding(
+                "device-sync", model.relpath, node.lineno,
+                "block_until_ready fences the device stream inside a "
+                "dispatcher-cycle module; the fused dispatch path must "
+                "stay launch-and-go"))
+    return findings

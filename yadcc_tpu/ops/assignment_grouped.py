@@ -272,19 +272,26 @@ def group_pad(n: int, floor: int = 4) -> int:
     return pad
 
 
-def make_grouped_packed(groups, pad_to: int) -> jax.Array:
-    """groups: [(env_id, min_version, requestor, count)] -> ONE [4, G]
-    int32 device block (a single H2D transfer).  Unpack on device with
-    `unpack_grouped` INSIDE a jitted caller: slicing on the host side
-    would issue four separate device ops per dispatch cycle, and on a
-    remote-attached accelerator each op costs ~1ms of dispatch."""
+def make_grouped_packed_host(groups, pad_to: int) -> np.ndarray:
+    """Host half of make_grouped_packed: the [4, G] int32 block as a
+    numpy array, for callers that stack several shards' blocks before
+    one combined upload (the fused control plane)."""
     g = len(groups)
     assert g <= pad_to
     a = np.zeros((4, pad_to), np.int32)
     a[2, :] = -1               # requestor padding: "no self-avoid slot"
     if g:                      # count padding stays 0: grants nothing
         a[:, :g] = np.asarray(groups, np.int32).T
-    return jnp.asarray(a)
+    return a
+
+
+def make_grouped_packed(groups, pad_to: int) -> jax.Array:
+    """groups: [(env_id, min_version, requestor, count)] -> ONE [4, G]
+    int32 device block (a single H2D transfer).  Unpack on device with
+    `unpack_grouped` INSIDE a jitted caller: slicing on the host side
+    would issue four separate device ops per dispatch cycle, and on a
+    remote-attached accelerator each op costs ~1ms of dispatch."""
+    return jnp.asarray(make_grouped_packed_host(groups, pad_to))
 
 
 def unpack_grouped(packed: jax.Array) -> GroupedBatch:
@@ -363,3 +370,149 @@ def make_grouped_batch(groups, pad_to: int) -> GroupedBatch:
     dispatch overhead is part of the p99 latency budget, and four
     separate tiny uploads cost ~4x one."""
     return unpack_grouped(make_grouped_packed(groups, pad_to))
+
+
+# ----------------------------------------------------------------------
+# Device-resident pool: scatter-delta updates + the fused resident step.
+#
+# The stream kernel above still re-uploads capacity and the (epoch-
+# cached) statics every launch; at S=50k that is ~200KB H2D per cycle
+# for state that barely changes between heartbeats.  The resident
+# protocol keeps the WHOLE PoolArrays on device across launches and
+# streams only what changed: dirty-slot indices plus their replacement
+# rows, a few hundred bytes per cycle.  Running corrections keep riding
+# the adj/reset fold (fold_stream_delta) — one definition for every
+# stream variant.
+# ----------------------------------------------------------------------
+
+
+class PoolDelta(NamedTuple):
+    """One launch's scatter-delta for the device-resident pool.
+
+    `idx` holds dirty slot indices; padding entries use idx == S (the
+    pool size) — definitively out of bounds, dropped by the scatter's
+    mode="drop" (NOT -1, which would wrap to the last slot under
+    negative indexing).  Values are the full replacement rows for each
+    dirty slot; `running` deliberately has no row here — it is chained
+    device state corrected via fold_stream_delta."""
+
+    idx: jax.Array        # int32[D] dirty slots; == S marks padding
+    alive: jax.Array      # int32[D] 0/1
+    capacity: jax.Array   # int32[D] effective capacity
+    dedicated: jax.Array  # int32[D] 0/1
+    version: jax.Array    # int32[D]
+    env_rows: jax.Array   # uint32[D, E//32]
+
+
+def delta_pad(n: int, floor: int = 64) -> int:
+    """Pad policy for the delta length: powers of two with a floor,
+    mirroring group_pad/task_pad — tight for the steady-state trickle
+    of dirty slots, a tiny closed shape set for the jit cache."""
+    pad = floor
+    while pad < n:
+        pad *= 2
+    return pad
+
+
+def make_pool_delta(dirty_idx, snap_arrays: dict, pad_to: int,
+                    pool_size: int) -> PoolDelta:
+    """Host-side delta assembly: gather the dirty slots' current rows
+    from the (host-authoritative) snapshot arrays and pad with the
+    idx == S sentinel.  One small H2D per field; all ride the single
+    resident launch."""
+    idx = np.asarray(dirty_idx, np.int64)
+    d = idx.shape[0]
+    assert d <= pad_to
+    pidx = np.full(pad_to, pool_size, np.int32)
+    pidx[:d] = idx
+
+    def take(name, dtype):
+        a = np.zeros(pad_to, dtype)
+        if d:
+            a[:d] = snap_arrays[name][idx]
+        return jnp.asarray(a)
+
+    env_words = snap_arrays["env_bitmap"].shape[1]
+    env = np.zeros((pad_to, env_words), np.uint32)
+    if d:
+        env[:d] = snap_arrays["env_bitmap"][idx]
+    return PoolDelta(
+        idx=jnp.asarray(pidx),
+        alive=take("alive", np.int32),
+        capacity=take("capacity", np.int32),
+        dedicated=take("dedicated", np.int32),
+        version=take("version", np.int32),
+        env_rows=jnp.asarray(env),
+    )
+
+
+def apply_pool_delta(pool: PoolArrays, delta: PoolDelta) -> PoolArrays:
+    """Scatter the delta rows into the resident pool (running
+    untouched).  Padding indices (== S) fall off the end and are
+    dropped; duplicate indices are fine (last write wins per XLA
+    scatter semantics, and the host sends each slot at most once)."""
+    i = delta.idx
+    return pool._replace(
+        alive=pool.alive.at[i].set(delta.alive != 0, mode="drop"),
+        capacity=pool.capacity.at[i].set(delta.capacity, mode="drop"),
+        dedicated=pool.dedicated.at[i].set(delta.dedicated != 0,
+                                           mode="drop"),
+        version=pool.version.at[i].set(delta.version, mode="drop"),
+        env_bitmap=pool.env_bitmap.at[i].set(delta.env_rows,
+                                             mode="drop"),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("t_max", "cost_model"),
+                   donate_argnums=(0,))
+def resident_grouped_step(
+    pool: PoolArrays,
+    delta: PoolDelta,
+    packed: jax.Array,
+    adj: jax.Array,
+    reset_mask: jax.Array,
+    reset_val: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, PoolArrays]:
+    """THE fused device-resident dispatch step: scatter the statics
+    delta, fold the running corrections, run the grouped assignment
+    (the device updates its own `running` from its own picks), and
+    expand to flat picks — all in ONE launch.  Returns (picks, pool):
+    the pool never leaves the device (donated in, so the update is an
+    in-place buffer reuse); the picks are the only D2H bytes.
+
+    Invariant (shared with assign_grouped_picks_stream): device
+    running = host authoritative running + grants of in-flight
+    launches; device statics = host statics as of the last delta."""
+    pool = apply_pool_delta(pool, delta)
+    running = fold_stream_delta(pool.running, adj, reset_mask, reset_val)
+    batch = unpack_grouped(packed)
+    counts, running = assign_grouped(
+        pool._replace(running=running), batch, cost_model)
+    picks = expand_counts(counts, batch.count, t_max)
+    return picks, pool._replace(running=running)
+
+
+@functools.partial(jax.jit, static_argnames=("cost_model",),
+                   donate_argnums=(0,))
+def resident_grouped_step_counts(
+    pool: PoolArrays,
+    delta: PoolDelta,
+    packed: jax.Array,
+    adj: jax.Array,
+    reset_mask: jax.Array,
+    reset_val: jax.Array,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+) -> Tuple[jax.Array, PoolArrays]:
+    """The counts twin of resident_grouped_step: same fused scatter +
+    fold + grouped assignment, but returns the per-(group, slot) grant
+    counts instead of the expanded flat picks — the host-platform shape
+    (policy._decide_expand: on CPU the dense task-expansion compare is
+    pure overhead, the caller expands from counts for free)."""
+    pool = apply_pool_delta(pool, delta)
+    running = fold_stream_delta(pool.running, adj, reset_mask, reset_val)
+    counts, running = assign_grouped(
+        pool._replace(running=running), unpack_grouped(packed),
+        cost_model)
+    return counts, pool._replace(running=running)

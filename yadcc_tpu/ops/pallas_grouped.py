@@ -306,3 +306,36 @@ def pallas_assign_grouped_picks_stream(
     return pallas_assign_grouped_picks_packed(
         pool._replace(running=running), packed, t_max, cost_model,
         interpret=interpret)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("t_max", "cost_model", "interpret"),
+    donate_argnums=(0,))
+def pallas_resident_grouped_step(
+    pool: PoolArrays,
+    delta,
+    packed: jax.Array,
+    adj: jax.Array,
+    reset_mask: jax.Array,
+    reset_val: jax.Array,
+    t_max: int,
+    cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+    interpret: bool = False,
+) -> Tuple[jax.Array, PoolArrays]:
+    """Fused device-resident step through the Pallas grouped kernel
+    (assignment_grouped.resident_grouped_step is the pure-XLA twin;
+    outcomes must match bit-for-bit).  The delta scatter, running fold
+    and grant expansion are XLA ops spliced around the pallas_call in
+    ONE executable; the pool is donated, so the statics update is an
+    in-place buffer reuse and nothing but the picks crosses D2H."""
+    from .assignment_grouped import (apply_pool_delta, expand_counts,
+                                     fold_stream_delta, unpack_grouped)
+
+    pool = apply_pool_delta(pool, delta)
+    running = fold_stream_delta(pool.running, adj, reset_mask, reset_val)
+    batch = unpack_grouped(packed)
+    counts, running = pallas_assign_grouped(
+        pool._replace(running=running), batch, cost_model,
+        interpret=interpret)
+    picks = expand_counts(counts, batch.count, t_max)
+    return picks, pool._replace(running=running)

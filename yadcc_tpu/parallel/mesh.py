@@ -523,6 +523,82 @@ def shard_pool_loads(mesh: Mesh, alive: np.ndarray, capacity: np.ndarray,
             jax.device_put(running, sh))
 
 
+def resident_control_plane_step_fn(
+        mesh: Mesh, t_max: int,
+        cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+        *, return_picks: bool = True):
+    """ONE sharded launch replacing the N per-shard policy calls of the
+    sharded control plane (scheduler/shard_router.py).
+
+    Control-plane shards are INDEPENDENT pools — shard k's dispatcher
+    owns global slots [k*per, (k+1)*per) and never scores another
+    shard's servants — so unlike the pod-scale kernels above this
+    shard_map body needs NO collectives at all: each device applies its
+    shard's scatter-delta, folds its shard's running corrections, runs
+    the LOCAL grouped threshold search over its own slice with its own
+    [4, G] descriptor block, and expands its own picks.  N policy
+    launches (N Python dispatches, N sets of transfers) become one.
+
+    Layout (one shard slice per linear device, the
+    control_plane_shard_slices convention):
+      pool      PoolArrays over the concatenated [N*per] servant axis,
+                sharded P(axes); env_bitmap [N*per, E//32]
+      delta     PoolDelta stacked on a leading shard axis: idx/alive/
+                capacity/dedicated/version [N, D], env_rows
+                [N, D, E//32]; idx entries == per mark padding (LOCAL
+                slot numbering — each shard's dirty slots are local)
+      packed    int32[N, 4, G] per-shard descriptor blocks
+      adj, reset_mask, reset_val   concatenated [N*per]
+    Returns (picks int32[N, t_max] — shard-local slot indices, NO_PICK
+    padded — and the updated sharded pool, which never leaves the
+    devices: callers thread it into the next call).
+
+    return_picks=False swaps the in-kernel expansion for a counts
+    return (int32[N, G, per]; t_max is then unused so one compilation
+    serves every cycle) — the same device-vs-host expansion trade the
+    grouped policy's _decide_expand makes: off-TPU the dense [t_max,
+    per] expansion compare dominates the launch, and the host rebuilds
+    per-task picks from the counts matrix with one np.repeat."""
+    from ..ops.assignment_grouped import (PoolDelta, apply_pool_delta,
+                                          assign_grouped,
+                                          expand_counts,
+                                          fold_stream_delta,
+                                          unpack_grouped)
+
+    axes = tuple(mesh.axis_names)
+    cm = cost_model
+
+    def body(pool: PoolArrays, delta: PoolDelta, packed, adj, rmask,
+             rval):
+        local = PoolDelta(*(a[0] for a in delta))
+        pool = apply_pool_delta(pool, local)
+        running = fold_stream_delta(pool.running, adj, rmask, rval)
+        batch = unpack_grouped(packed[0])
+        counts, running = assign_grouped(
+            pool._replace(running=running), batch, cm)
+        if return_picks:
+            out = expand_counts(counts, batch.count, t_max)
+        else:
+            out = counts
+        return out[None], pool._replace(running=running)
+
+    pool_spec = pool_partition_spec(axes)
+    delta_spec = PoolDelta(
+        idx=P(axes, None), alive=P(axes, None), capacity=P(axes, None),
+        dedicated=P(axes, None), version=P(axes, None),
+        env_rows=P(axes, None, None))
+    out_spec = P(axes, None) if return_picks else P(axes, None, None)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pool_spec, delta_spec, P(axes, None, None), P(axes),
+                  P(axes), P(axes)),
+        out_specs=(out_spec, pool_spec),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 def shard_load_summary_fn(mesh: Mesh):
     """Build the jitted per-shard load reducer: (alive bool[S],
     effective_capacity int32[S], running int32[S]) sharded one shard

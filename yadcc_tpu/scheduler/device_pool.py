@@ -1,0 +1,239 @@
+"""DeviceResidentPool: the servant pool lives on the accelerator.
+
+Every earlier device policy re-uploads per-cycle pool state (capacity
+and running always; the epoch-cached statics whenever the fleet
+churns), so the dispatch hot loop is bounded by transfers and
+per-launch Python, not compute — BENCH_r05's 613k assignments/s
+headline with `cpu_fallback: true`.  This module inverts the data flow:
+the full PoolArrays stays device-resident across dispatch cycles and
+the host streams only what changed, riding the dispatcher's existing
+dirty-slot tracking (task_dispatcher._mark_slot_dirty_locked):
+
+* statics + capacity deltas scatter in as small int32 batches
+  (ops/assignment_grouped.PoolDelta — dirty-slot indices + replacement
+  rows, idx == S sentinel padding);
+* running corrections ride the established adj/reset fold
+  (fold_stream_delta — one definition for every stream variant);
+* the whole score→assign→grant-delta policy stage is ONE fused launch
+  (resident_grouped_step, or its Pallas twin on TPU) in which the
+  device updates its own `running` from its own picks;
+* only the picked slot indices come back — one small async D2H per
+  cycle.
+
+The host keeps applying the same deltas to its authoritative arrays
+(the dispatcher's bookkeeping is unchanged), and a periodic equivalence
+ORACLE — the PR 2 snapshot-equivalence pattern, applied device-side —
+downloads the resident statics every `oracle_interval` launches,
+asserts they match the host snapshot bit-for-bit, and re-syncs (with a
+counter) instead of serving from drifted state if they ever diverge.
+`running` is deliberately outside the oracle: mid-stream it includes
+grants of in-flight launches by design (the stream invariant), so only
+the reset-barrier protocol and chain reseeds govern it.
+
+Failure modes (doc/scheduler.md, "Device-resident dispatch"):
+* delta overflow (a churn storm dirties more slots than the delta pad
+  ladder carries) -> full statics re-upload, counted, correctness
+  unaffected;
+* oracle mismatch (a lost or misapplied scatter) -> log + resync +
+  counter; the next launch serves from re-seeded statics;
+* device error mid-stream -> the dispatcher's pipelined loop already
+  reseeds via stream_begin, which lands here as seed().
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..models.cost import DEFAULT_COST_MODEL, DispatchCostModel
+from ..utils.logging import get_logger
+
+logger = get_logger("scheduler.device_pool")
+
+# Dirty sets past this fraction of the pool re-upload the statics
+# wholesale instead of scattering (same break-even shape as the
+# snapshot buffers' _SNAP_FULL_REBUILD_FRAC).
+_DELTA_FULL_SYNC_FRAC = 8  # 1/8 of slots
+
+
+class DeviceResidentPool:
+    """Owns one dispatcher's device-resident PoolArrays and its delta
+    protocol.  NOT thread-safe: exactly one stream driver (the
+    pipelined dispatch thread, or the fused router cycle) may touch an
+    instance — the same single-writer discipline the stream_* policy
+    API already imposes."""
+
+    def __init__(self, cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+                 *, use_pallas: Optional[bool] = None,
+                 oracle_interval: int = 64):
+        self._cm = cost_model
+        self._use_pallas = use_pallas
+        self._oracle_interval = max(1, oracle_interval)
+        self._pool = None          # device PoolArrays, or None before seed
+        self._size = 0
+        self._env_words = 0
+        self._launches = 0
+        self.stats: Dict[str, int] = {
+            "seeds": 0,            # full uploads (begin/reseed)
+            "delta_launches": 0,   # scatter-delta fused steps
+            "delta_slots": 0,      # dirty slots streamed, total
+            "full_syncs": 0,       # statics re-uploads (overflow/None)
+            "oracle_checks": 0,
+            "oracle_mismatches": 0,
+        }
+
+    # -- residency ----------------------------------------------------------
+
+    def seed(self, snap) -> None:
+        """Absolute sync point: upload the full snapshot, replacing any
+        resident state (startup, stream reseed after a device error)."""
+        import jax.numpy as jnp
+
+        from ..ops.assignment import PoolArrays
+
+        self._pool = PoolArrays(
+            alive=jnp.asarray(snap.alive),
+            capacity=jnp.asarray(snap.capacity.astype(np.int32)),
+            running=jnp.asarray(snap.running.astype(np.int32)),
+            dedicated=jnp.asarray(snap.dedicated),
+            version=jnp.asarray(snap.version.astype(np.int32)),
+            env_bitmap=jnp.asarray(snap.env_bitmap),
+        )
+        self._size = int(snap.alive.shape[0])
+        self._env_words = int(snap.env_bitmap.shape[1])
+        self._launches = 0
+        self.stats["seeds"] += 1
+
+    @property
+    def seeded(self) -> bool:
+        return self._pool is not None
+
+    def _snap_arrays(self, snap) -> dict:
+        return {
+            "alive": snap.alive, "capacity": snap.capacity,
+            "dedicated": snap.dedicated, "version": snap.version,
+            "env_bitmap": snap.env_bitmap,
+        }
+
+    def _resync_statics(self, snap) -> None:
+        """Re-upload statics wholesale, keeping the chained running
+        (which carries in-flight grants the snapshot cannot know)."""
+        import jax.numpy as jnp
+
+        self._pool = self._pool._replace(
+            alive=jnp.asarray(snap.alive),
+            capacity=jnp.asarray(snap.capacity.astype(np.int32)),
+            dedicated=jnp.asarray(snap.dedicated),
+            version=jnp.asarray(snap.version.astype(np.int32)),
+            env_bitmap=jnp.asarray(snap.env_bitmap),
+        )
+        self.stats["full_syncs"] += 1
+
+    # -- the fused step -----------------------------------------------------
+
+    def _pallas_route(self):
+        """(use_pallas, interpret) for this geometry — Pallas only where
+        its VMEM plan fits; interpret mode off-TPU (parity, not speed)."""
+        if self._use_pallas is False:
+            return False, False
+        import jax
+
+        from ..ops.pallas_grouped import _vmem_plan
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if self._use_pallas is None and not on_tpu:
+            return False, False
+        try:
+            _vmem_plan(4, self._size, self._env_words)
+        except ValueError:
+            return False, False
+        return True, not on_tpu
+
+    def step(self, snap, dirty: Optional[Sequence[int]], descr,
+             adj: np.ndarray, reset_slots: Dict[int, int], t_max: int):
+        """One fused resident dispatch step; returns the device picks
+        array (int32[t_max], flat over `descr` run order) with the
+        async D2H copy started.  The resident pool advances in place.
+
+        dirty: slots whose statics/capacity changed since the last step
+        (the dispatcher's dirty-slot export); None means the caller
+        lost track — resolved as a counted full statics re-sync."""
+        import jax.numpy as jnp
+
+        from ..ops import assignment_grouped as asg
+
+        if self._pool is None:
+            raise RuntimeError("DeviceResidentPool.step before seed()")
+        s = self._size
+
+        if dirty is None or (
+                len(dirty) * _DELTA_FULL_SYNC_FRAC > s):
+            self._resync_statics(snap)
+            dirty = ()
+        delta = asg.make_pool_delta(
+            np.fromiter(dirty, np.int64, len(dirty)),
+            self._snap_arrays(snap),
+            pad_to=asg.delta_pad(len(dirty)), pool_size=s)
+        self.stats["delta_slots"] += len(dirty)
+
+        packed = asg.make_grouped_packed(
+            descr, pad_to=asg.group_pad(len(descr)))
+        rmask = np.zeros(s, bool)
+        rval = np.zeros(s, np.int32)
+        for slot, val in reset_slots.items():
+            rmask[slot] = True
+            rval[slot] = val
+
+        use_pallas, interpret = self._pallas_route()
+        args = (self._pool, delta, packed,
+                jnp.asarray(adj.astype(np.int32)), jnp.asarray(rmask),
+                jnp.asarray(rval), t_max, self._cm)
+        if use_pallas:
+            from ..ops.pallas_grouped import pallas_resident_grouped_step
+
+            picks, self._pool = pallas_resident_grouped_step(
+                *args, interpret=interpret)
+        else:
+            picks, self._pool = asg.resident_grouped_step(*args)
+        picks.copy_to_host_async()
+        self.stats["delta_launches"] += 1
+        self._launches += 1
+
+        if self._launches % self._oracle_interval == 0:
+            self.oracle_check(snap)
+        return picks
+
+    # -- equivalence oracle -------------------------------------------------
+
+    def oracle_check(self, snap) -> bool:
+        """Download the resident statics and assert bit-parity with the
+        host snapshot (the PR 2 snapshot-equivalence pattern, applied
+        across the PCIe/ICI boundary).  On mismatch: log, count,
+        re-sync — the stream keeps serving from repaired state rather
+        than drifting.  Returns True when parity held."""
+        self.stats["oracle_checks"] += 1
+        pool = self._pool
+        fields = ("alive", "capacity", "dedicated", "version",
+                  "env_bitmap")
+        host = {f: np.asarray(  # ytpu: allow(device-sync)  # oracle sync
+                getattr(pool, f))
+                for f in fields}
+        ok = all(np.array_equal(host[f], getattr(snap, f))
+                 for f in fields)
+        if not ok:
+            self.stats["oracle_mismatches"] += 1
+            logger.error(
+                "device-resident statics diverged from the host "
+                "snapshot after %d launches; re-syncing", self._launches)
+            self._resync_statics(snap)
+        return ok
+
+    @property
+    def running(self):
+        """The chained device running array (for stream collectors and
+        parity tests; mid-stream it includes in-flight grants)."""
+        return self._pool.running if self._pool is not None else None
+
+    def inspect(self) -> dict:
+        return dict(self.stats)

@@ -205,8 +205,13 @@ class JaxBatchedPolicy(DispatchPolicy):
                 pad_to=self._max_batch,
             )
             picks, new_running = self._run_kernel(pool, batch)
-            picks_all.extend(int(p) for p in np.asarray(picks[: len(chunk)]))
-            running = np.asarray(new_running)
+            # The blocking policies collect per chunk by contract; the
+            # resident stream path is the one that must stay async.
+            got = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                picks[: len(chunk)])
+            picks_all.extend(int(p) for p in got)
+            running = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                new_running)
         return picks_all
 
     # Hooks for subclasses sharing the chunk/pad/carry loop.
@@ -333,6 +338,48 @@ class JaxGroupedPolicy(DispatchPolicy):
 
         self._stream_running = jnp.asarray(snap.running)
         self._stream_next_id = 0
+        self._stream_epoch = snap.epoch
+
+    # -- stale-stream guard ------------------------------------------------
+    #
+    # A stream chain seeded before registry compaction (or against a
+    # different pool width) used to trust the caller to reset it; a
+    # stale chain silently scores against dead running counts.  Every
+    # stream_launch now passes through _stream_guard: an unseeded or
+    # wrong-width chain auto-resyncs (counted — inspect() surfaces it),
+    # and a snapshot whose epoch moved BACKWARD relative to the chain
+    # is a caller bug (snapshots are produced under the dispatcher lock
+    # and epochs only ever advance) — that asserts.  Epoch ADVANCE
+    # without a reseed is legitimate: joins/leaves/version bumps ride
+    # the adj/reset delta protocol by design.
+
+    def _stream_seeded(self, snap) -> bool:
+        running = getattr(self, "_stream_running", None)
+        return (running is not None
+                and running.shape[0] == snap.running.shape[0])
+
+    def _stream_guard(self, snap) -> None:
+        if not self._stream_seeded(snap):
+            self.stream_begin(snap)
+            self._stream_resyncs = getattr(self, "_stream_resyncs", 0) + 1
+            return
+        last = getattr(self, "_stream_epoch", -1)
+        if snap.epoch >= 0 and last >= 0 and snap.epoch < last:
+            raise ValueError(
+                f"pool epoch moved backward under a live stream "
+                f"({last} -> {snap.epoch}): snapshots are produced "
+                f"under the dispatcher lock and epochs are monotonic, "
+                f"so this stream chain belongs to a different pool — "
+                f"call stream_begin() with a fresh snapshot")
+        self._stream_epoch = snap.epoch
+
+    def stream_stats(self) -> dict:
+        """Stream-health counters for inspect(): auto-resyncs taken by
+        the stale-stream guard and the epoch the chain last saw."""
+        return {
+            "resyncs": getattr(self, "_stream_resyncs", 0),
+            "epoch": getattr(self, "_stream_epoch", -1),
+        }
 
     def _prepare_warm_pool(self, pool):
         """Hook: place the warmup pool EXACTLY like live launches place
@@ -381,7 +428,8 @@ class JaxGroupedPolicy(DispatchPolicy):
         return asg.assign_grouped_picks_stream(
             pool, packed, adj, rmask, rval, t_max, self._cm)
 
-    def stream_launch(self, snap, descr, adj, reset_slots) -> StreamTicket:
+    def stream_launch(self, snap, descr, adj, reset_slots,
+                      dirty=None) -> StreamTicket:
         """Launch one chunk without waiting for the result.
 
         snap: PoolSnapshot for statics + per-launch capacity (its
@@ -389,11 +437,15 @@ class JaxGroupedPolicy(DispatchPolicy):
         descr: [(env_id, min_version, requestor_slot, count)] runs, in
         work order; the flat picks positions map 1:1 to that order.
         adj: int32[S] signed host corrections since the last launch.
-        reset_slots: {slot: absolute_running} overrides."""
+        reset_slots: {slot: absolute_running} overrides.
+        dirty: slots whose statics changed since the last launch — only
+        the device-RESIDENT subclass consumes it (scatter deltas); this
+        epoch-cached upload path re-reads the snapshot wholesale."""
         import jax.numpy as jnp
 
         from ..ops import assignment_grouped as asg
 
+        self._stream_guard(snap)
         # _prepare_grouped_pool is the placement hook: epoch-cached
         # device upload here, mesh-sharded placement in the pod-scale
         # subclass.  The chained running passes through jnp.asarray /
@@ -420,7 +472,10 @@ class JaxGroupedPolicy(DispatchPolicy):
         return ticket.picks.is_ready()
 
     def stream_collect(self, ticket: StreamTicket) -> np.ndarray:
-        return np.asarray(ticket.picks)
+        # THE sanctioned D2H point of the stream: the apply boundary,
+        # reached after stream_ready (or accepting the blocking wait).
+        return np.asarray(  # ytpu: allow(device-sync)  # apply boundary
+            ticket.picks)
 
     def _chunk_runs(self, runs):
         """Split the run list into kernel-sized chunks: at most
@@ -531,8 +586,10 @@ class JaxGroupedPolicy(DispatchPolicy):
                 flat, new_running = self._run_picks_kernel(
                     pool, asg.make_grouped_packed(descr, pad_to=pad),
                     t_pad)
-                flat = np.asarray(flat)
-                running = np.asarray(new_running)
+                flat = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                    flat)
+                running = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                    new_running)
                 off = 0
                 for (_, member_idx), size in zip(chunk, sizes):
                     for req_idx, s in zip(member_idx, flat[off:off + size]):
@@ -541,8 +598,10 @@ class JaxGroupedPolicy(DispatchPolicy):
                 continue
             counts, new_running = self._run_grouped_kernel(
                 pool, asg.make_grouped_batch(descr, pad_to=pad))
-            counts = np.asarray(counts)
-            running = np.asarray(new_running)
+            counts = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                counts)
+            running = np.asarray(  # ytpu: allow(device-sync)  # sync collect
+                new_running)
             # Expand (group, slot)->count into per-request picks with
             # one pass over the counts matrix for the whole chunk
             # (np.nonzero yields row-major order, i.e. grouped by
@@ -635,6 +694,7 @@ class JaxShardedGroupedPolicy(JaxGroupedPolicy):
         self._stream_running = jax.device_put(
             snap.running, pmesh.pool_sharding(self._mesh).running)
         self._stream_next_id = 0
+        self._stream_epoch = snap.epoch
 
     def _run_stream_kernel(self, pool, packed, adj, rmask, rval,
                            t_max: int):
@@ -751,6 +811,89 @@ class JaxPallasGroupedPolicy(JaxGroupedPolicy):
         return pallas_assign_grouped_picks_stream(
             pool, packed, adj, rmask, rval, t_max, self._cm,
             interpret=interpret)
+
+
+class JaxResidentGroupedPolicy(JaxGroupedPolicy):
+    """The device-resident dispatch policy (the tentpole): the FULL
+    PoolArrays lives on device across cycles (scheduler/device_pool.py)
+    and every stream launch is one fused scatter→fold→assign→expand
+    step with buffer donation — no per-cycle pool upload at all.  The
+    host streams dirty-slot deltas (the dispatcher's `dirty=` export);
+    only picks come back.  Sync assign() deliberately stays the
+    inherited upload path: residency is a property of the stream, and
+    the stream guard/reseed machinery is what keeps it honest."""
+
+    name = "jax_resident_grouped"
+    # The dispatcher checks this to pass its dirty-slot export through
+    # stream_launch(dirty=...) instead of relying on epoch caching.
+    supports_resident = True
+
+    def __init__(self, max_groups: int = 64,
+                 cost_model: DispatchCostModel = DEFAULT_COST_MODEL,
+                 *, use_pallas: "bool | None" = None,
+                 oracle_interval: int = 64):
+        super().__init__(max_groups, cost_model)
+        from .device_pool import DeviceResidentPool
+
+        self.resident_pool = DeviceResidentPool(
+            cost_model, use_pallas=use_pallas,
+            oracle_interval=oracle_interval)
+
+    def stream_begin(self, snap) -> None:
+        self.resident_pool.seed(snap)
+        self._stream_next_id = 0
+        self._stream_epoch = snap.epoch
+
+    def _stream_seeded(self, snap) -> bool:
+        rp = self.resident_pool
+        return (rp.seeded
+                and rp.running.shape[0] == snap.running.shape[0])
+
+    def stream_warmup(self, pool_size: int, env_words: int = 8) -> None:
+        """Compile the resident step's (group pad, task pad) ladder at
+        the floor delta pad (deltas between heartbeats are tiny; bigger
+        dirty sets escalate to a full re-sync, which compiles nothing).
+        The zero pool seeded here is replaced by the real stream_begin."""
+        from ..ops import assignment_grouped as asg
+
+        snap = PoolSnapshot(
+            alive=np.zeros(pool_size, bool),
+            capacity=np.zeros(pool_size, np.int32),
+            running=np.zeros(pool_size, np.int32),
+            dedicated=np.zeros(pool_size, bool),
+            version=np.zeros(pool_size, np.int32),
+            env_bitmap=np.zeros((pool_size, env_words), np.uint32))
+        self.resident_pool.seed(snap)
+        adj = np.zeros(pool_size, np.int32)
+        pad = asg.group_pad(0)
+        while True:
+            t_pad = asg.task_pad(0)
+            descr = [(0, 0, -1, 0)] * pad
+            while True:
+                self.resident_pool.step(snap, (), descr, adj, {}, t_pad)
+                if t_pad >= self._TASK_CAP:
+                    break
+                t_pad *= 2
+            if pad >= self._max_groups:
+                break
+            pad *= 2
+
+    def stream_launch(self, snap, descr, adj, reset_slots,
+                      dirty=None) -> StreamTicket:
+        from ..ops import assignment_grouped as asg
+
+        self._stream_guard(snap)
+        t_pad = asg.task_pad(sum(d[3] for d in descr))
+        picks = self.resident_pool.step(
+            snap, dirty, descr, adj, reset_slots, t_pad)
+        ticket = StreamTicket(self._stream_next_id, picks)
+        self._stream_next_id += 1
+        return ticket
+
+    def stream_stats(self) -> dict:
+        stats = super().stream_stats()
+        stats.update(self.resident_pool.inspect())
+        return stats
 
 
 class JaxPallasPolicy(JaxBatchedPolicy):
@@ -881,14 +1024,18 @@ class AutoPolicy(DispatchPolicy):
     def stream_warmup(self, pool_size: int, env_words: int = 8) -> None:
         self._grouped.stream_warmup(pool_size, env_words)
 
-    def stream_launch(self, snap, descr, adj, reset_slots):
-        return self._grouped.stream_launch(snap, descr, adj, reset_slots)
+    def stream_launch(self, snap, descr, adj, reset_slots, dirty=None):
+        return self._grouped.stream_launch(snap, descr, adj, reset_slots,
+                                           dirty=dirty)
 
     def stream_ready(self, ticket) -> bool:
         return self._grouped.stream_ready(ticket)
 
     def stream_collect(self, ticket):
         return self._grouped.stream_collect(ticket)
+
+    def stream_stats(self) -> dict:
+        return self._grouped.stream_stats()
 
     def _use_greedy(self, snap, n: int) -> bool:
         if self._threshold is not None:
@@ -930,6 +1077,10 @@ def make_policy(name: str, max_servants: int,
         return JaxShardedPolicy(max_servants, cost_model=cm)
     if name == "jax_pallas_grouped":
         return JaxPallasGroupedPolicy(cost_model=cm)
+    if name == "jax_resident_grouped":
+        return JaxResidentGroupedPolicy(cost_model=cm)
+    if name == "jax_resident_pallas_grouped":
+        return JaxResidentGroupedPolicy(cost_model=cm, use_pallas=True)
     if name == "jax_sharded_grouped":
         return JaxShardedGroupedPolicy(cost_model=cm)
     if name == "auto":

@@ -569,7 +569,9 @@ class ShardRouter:
         alive, cap, running = (cat(0, bool), cat(1, np.int32),
                                cat(2, np.int32))
         a, c, r = shard_pool_loads(self._mesh, alive, cap, running)
-        rows = np.asarray(self._mesh_fn(a, c, r))
+        # Rebalance-tick cadence, off the dispatch cycle.
+        rows = np.asarray(  # ytpu: allow(device-sync)  # rebalance tick
+            self._mesh_fn(a, c, r))
         with self._lock:
             self._mesh_rows = rows
 
@@ -579,6 +581,278 @@ class ShardRouter:
         with self._lock:
             return None if self._mesh_rows is None \
                 else self._mesh_rows.copy()
+
+    # -- fused device-resident dispatch -------------------------------------
+    #
+    # The PR-9 control plane runs N per-shard policy calls per sweep; at
+    # 8 shards that is 8 Python dispatches, 8 upload sets, 8 picks
+    # downloads — per cycle.  The fused path makes the accelerator the
+    # control plane's hot loop instead: the CONCATENATED pool (N*per
+    # slots) is device-resident, sharded one shard slice per device
+    # (parallel/mesh.py control_plane_shard_slices layout), and each
+    # cycle is ONE sharded launch (resident_control_plane_step_fn) in
+    # which every device scatters its shard's dirty-slot delta, folds
+    # its running corrections, and runs its shard's grouped assignment
+    # locally — no collectives, because shards are independent pools.
+    # Per-shard picks route back through each shard's UNMODIFIED grant
+    # bookkeeping (apply_stream_picks — the same validation path the
+    # in-process pipelined loop uses).
+
+    def enable_fused_dispatch(self, *, oracle_interval: int = 64,
+                              cost_model=None) -> None:
+        """Seed the device-resident concatenated pool and arm every
+        shard's stream delta machinery.  Requires shards built with
+        start_dispatch_thread=False (the fused cycle is the one stream
+        driver) and equal pool widths (the mesh layout is uniform)."""
+        import jax
+
+        from ..parallel import mesh as pmesh
+
+        mesh = self._mesh if self._mesh is not None else pmesh.make_mesh()
+        n = len(self._shards)
+        n_dev = int(np.prod(list(mesh.shape.values())))
+        if n_dev != n:
+            raise ValueError(
+                f"mesh has {n_dev} devices for {n} shards; fused "
+                "dispatch needs one device per shard")
+        widths = {d.max_servants for d in self._shards}
+        if len(widths) != 1:
+            raise ValueError(
+                f"fused dispatch needs equal shard pool widths, got "
+                f"{sorted(widths)}")
+        snaps = [d.begin_external_stream() for d in self._shards]
+        per = self._shards[0].max_servants
+        sh = pmesh.pool_sharding(mesh)
+
+        def cat(field, dtype=None):
+            a = np.concatenate([getattr(s, field) for s in snaps])
+            return a if dtype is None else a.astype(dtype)
+
+        from ..models.cost import DEFAULT_COST_MODEL
+        from ..ops.assignment import PoolArrays
+
+        pool = PoolArrays(
+            alive=jax.device_put(cat("alive"), sh.alive),
+            capacity=jax.device_put(cat("capacity", np.int32),
+                                    sh.capacity),
+            running=jax.device_put(cat("running", np.int32), sh.running),
+            dedicated=jax.device_put(cat("dedicated"), sh.dedicated),
+            version=jax.device_put(cat("version", np.int32), sh.version),
+            env_bitmap=jax.device_put(cat("env_bitmap"), sh.env_bitmap),
+        )
+        if cost_model is None:
+            cost_model = getattr(self._shards[0]._policy, "_cm",
+                                 DEFAULT_COST_MODEL)
+        self._fused = {
+            "mesh": mesh, "pool": pool, "per": per, "cm": cost_model,
+            "fns": {}, "cycles": 0,
+            "oracle_interval": max(1, oracle_interval),
+            "stats": {"fused_cycles": 0, "fused_shard_launches": 0,
+                      "oracle_checks": 0, "oracle_mismatches": 0},
+        }
+
+    def run_fused_cycle(self) -> int:
+        """One fused control-plane cycle: prepare every shard's launch,
+        run ONE sharded device step, apply each shard's picks through
+        its own grant bookkeeping.  Returns grants issued.  Synchronous
+        by design — the point is one launch for N shards, and the
+        per-shard apply happens as soon as the single picks array
+        lands."""
+        import jax.numpy as jnp
+
+        from ..ops import assignment_grouped as asg
+        from ..ops.assignment import NO_PICK
+        from ..ops.assignment_grouped import PoolDelta
+        from ..parallel.mesh import resident_control_plane_step_fn
+
+        fused = getattr(self, "_fused", None)
+        if fused is None:
+            raise RuntimeError("call enable_fused_dispatch() first")
+        n, per = len(self._shards), fused["per"]
+        launches = [d.prepare_stream_launch() for d in self._shards]
+        if all(l is None for l in launches):
+            return 0
+        try:
+            # Common pad geometry: every shard rides the same launch, so
+            # shapes unify to the cycle's maxima (the pad ladders keep
+            # the jit shape set tiny regardless).
+            g_pad = max(asg.group_pad(len(l[1]) if l else 0)
+                        for l in launches)
+            t_max = max(asg.task_pad(len(l[0]) if l else 0)
+                        for l in launches)
+            d_pad = max(asg.delta_pad(len(l[7]) if l else 0)
+                        for l in launches)
+            packed = np.zeros((n, 4, g_pad), np.int32)
+            adj = np.zeros(n * per, np.int32)
+            rmask = np.zeros(n * per, bool)
+            rval = np.zeros(n * per, np.int32)
+            idx = np.full((n, d_pad), per, np.int32)
+            alive = np.zeros((n, d_pad), np.int32)
+            cap = np.zeros((n, d_pad), np.int32)
+            ded = np.zeros((n, d_pad), np.int32)
+            ver = np.zeros((n, d_pad), np.int32)
+            e_words = self._shards[0]._env_words
+            env = np.zeros((n, d_pad, e_words), np.uint32)
+            for k, l in enumerate(launches):
+                if l is None:
+                    continue
+                work, descr, snap, gen, adjk, resets, lid, dirty = l
+                packed[k] = asg.make_grouped_packed_host(
+                    descr, pad_to=g_pad)
+                adj[k * per:(k + 1) * per] = adjk
+                for slot, val in resets.items():
+                    rmask[k * per + slot] = True
+                    rval[k * per + slot] = val
+                nd = len(dirty)
+                if nd:
+                    di = np.asarray(  # ytpu: allow(device-sync)  # host list
+                        dirty, np.int64)
+                    idx[k, :nd] = di
+                    alive[k, :nd] = snap.alive[di]
+                    cap[k, :nd] = snap.capacity[di]
+                    ded[k, :nd] = snap.dedicated[di]
+                    ver[k, :nd] = snap.version[di]
+                    env[k, :nd] = snap.env_bitmap[di]
+            delta = PoolDelta(
+                idx=jnp.asarray(idx), alive=jnp.asarray(alive),
+                capacity=jnp.asarray(cap), dedicated=jnp.asarray(ded),
+                version=jnp.asarray(ver), env_rows=jnp.asarray(env))
+            on_device = self._fused_expand_on_device()
+            key = t_max if on_device else "counts"
+            fn = fused["fns"].get(key)
+            if fn is None:
+                fn = resident_control_plane_step_fn(
+                    fused["mesh"], t_max, fused["cm"],
+                    return_picks=on_device)
+                fused["fns"][key] = fn
+            out_dev, fused["pool"] = fn(
+                fused["pool"], delta, jnp.asarray(packed),
+                jnp.asarray(adj), jnp.asarray(rmask), jnp.asarray(rval))
+            # The one D2H of the cycle: collecting the fused picks IS
+            # the apply boundary.
+            out = np.asarray(  # ytpu: allow(device-sync)  # apply boundary
+                out_dev)
+            if on_device:
+                rows = [None if l is None else out[k, :len(l[0])]
+                        for k, l in enumerate(launches)]
+            else:
+                # Host expansion from the [n, G, per] counts matrix
+                # (the grouped policy's off-TPU route): within a run
+                # every entry is the identical request, so slot-order
+                # repeat preserves the per-run pick multiset the apply
+                # validates.
+                rows = []
+                for k, l in enumerate(launches):
+                    if l is None:
+                        rows.append(None)
+                        continue
+                    row = np.full(len(l[0]), NO_PICK, np.int32)
+                    off = 0
+                    for gi, (_, _, _, cnt) in enumerate(l[1]):
+                        cs = out[k, gi]
+                        nz = np.nonzero(cs)[0]
+                        exp = np.repeat(nz, cs[nz])
+                        row[off:off + len(exp)] = exp
+                        off += cnt
+                    rows.append(row)
+        except Exception:
+            for d, l in zip(self._shards, launches):
+                if l is not None:
+                    d.release_stream_launch(l)
+            raise
+        fused["cycles"] += 1
+        fused["stats"]["fused_cycles"] += 1
+        if fused["cycles"] % fused["oracle_interval"] == 0:
+            self._fused_oracle(launches)
+        # Last-cycle detail for the parity gates (tools/pod_sim
+        # --device-resident --smoke, tests): the picks rows are copies,
+        # but the launch tuples reference leased snapshot buffers —
+        # consumers must copy anything they keep before the NEXT
+        # prepare recycles them.
+        fused["last_cycle"] = [
+            {"shard": k, "picks": rows[k].copy(), "launch": l}
+            for k, l in enumerate(launches) if l is not None]
+        issued = 0
+        for k, (d, l) in enumerate(zip(self._shards, launches)):
+            if l is None:
+                continue
+            work, descr, snap, gen, adjk, resets, lid, dirty = l
+            fused["stats"]["fused_shard_launches"] += 1
+            issued += d.apply_stream_picks(rows[k], work,
+                                           gen, lid, snap=snap)
+        return issued
+
+    def _fused_expand_on_device(self) -> bool:
+        """Device vs host picks expansion for the fused launch — the
+        grouped policy's _decide_expand trade at router scope: on TPU
+        the in-kernel expansion keeps the D2H at O(T) picks; off-TPU
+        the dense [t_max, per] expansion compare dominates the launch
+        and the counts matrix + np.repeat wins.  YTPU_GROUPED_EXPAND
+        overrides (parity tests drive both routes anywhere)."""
+        fused = self._fused
+        on_device = fused.get("expand_on_device")
+        if on_device is None:
+            import os
+
+            import jax
+
+            forced = os.environ.get("YTPU_GROUPED_EXPAND")
+            if forced in ("device", "host"):
+                on_device = forced == "device"
+            else:
+                on_device = jax.devices()[0].platform == "tpu"
+            fused["expand_on_device"] = on_device
+        return on_device
+
+    def _fused_oracle(self, launches) -> None:
+        """Periodic equivalence oracle over the resident statics: each
+        shard that launched this cycle compares its device slice
+        against the host snapshot the delta was gathered from (so they
+        must match bit-for-bit).  Mismatch -> log, count, repair the
+        slice in place.  `running` stays out — it legitimately carries
+        this cycle's not-yet-applied device grants."""
+        fused = self._fused
+        per = fused["per"]
+        pool = fused["pool"]
+        # One blocking download per field, oracle cadence only — the
+        # oracle is the explicit periodic sync point.
+        host = {f: np.asarray(  # ytpu: allow(device-sync)  # oracle sync
+                getattr(pool, f))
+                for f in ("alive", "capacity", "dedicated", "version",
+                          "env_bitmap")}
+        for k, l in enumerate(launches):
+            if l is None:
+                continue
+            snap = l[2]
+            sl = slice(k * per, (k + 1) * per)
+            fused["stats"]["oracle_checks"] += 1
+            ok = (np.array_equal(host["alive"][sl], snap.alive)
+                  and np.array_equal(host["capacity"][sl], snap.capacity)
+                  and np.array_equal(host["dedicated"][sl],
+                                     snap.dedicated)
+                  and np.array_equal(host["version"][sl], snap.version)
+                  and np.array_equal(host["env_bitmap"][sl],
+                                     snap.env_bitmap))
+            if not ok:
+                fused["stats"]["oracle_mismatches"] += 1
+                logger.error(
+                    "fused resident statics diverged on shard %d; "
+                    "re-syncing its slice", k)
+                fused["pool"] = fused["pool"]._replace(
+                    alive=fused["pool"].alive.at[sl].set(snap.alive),
+                    capacity=fused["pool"].capacity.at[sl].set(
+                        snap.capacity.astype(np.int32)),
+                    dedicated=fused["pool"].dedicated.at[sl].set(
+                        snap.dedicated),
+                    version=fused["pool"].version.at[sl].set(
+                        snap.version.astype(np.int32)),
+                    env_bitmap=fused["pool"].env_bitmap.at[sl].set(
+                        snap.env_bitmap),
+                )
+
+    def fused_stats(self) -> Optional[Dict[str, int]]:
+        fused = getattr(self, "_fused", None)
+        return dict(fused["stats"]) if fused else None
 
     # -- observability ------------------------------------------------------
 
@@ -627,6 +901,9 @@ class ShardRouter:
             },
             "latency_breakdown": self.aggregate_latency_breakdown(),
             "mesh_loads": mesh_rows,
+            # Fused device-resident cycle counters (None unless
+            # enable_fused_dispatch was called).
+            "fused": self.fused_stats(),
             "per_shard": per_shard,
         }
 

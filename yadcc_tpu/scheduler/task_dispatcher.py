@@ -299,6 +299,15 @@ class TaskDispatcher:
         self._pipe_reset_barrier = np.full(
             max_servants, -1, np.int64)  # guarded by: self._lock
         self._pipe_launch_seq = 0  # guarded by: self._lock
+        # Device-resident dispatch: slots whose STATICS or capacity
+        # changed since the last stream launch.  Each launch takes the
+        # set (in the same locked region that publishes the snapshot,
+        # so the delta values gathered from the leased snapshot match
+        # exactly what the set covers) and hands it to a resident
+        # policy as `dirty=` — the scatter-delta alternative to
+        # re-uploading the pool.  Rides the same _mark_slot_dirty path
+        # as the prepared-snapshot buffers.
+        self._stream_dirty: Set[int] = set()  # guarded by: self._lock
 
         # Inline-leader dispatch: the first waiter of an idle backlog
         # runs the cycle on its own thread (two condvar handoffs and
@@ -1022,6 +1031,8 @@ class TaskDispatcher:
                         self._pipe_active = True
                         self._pipe_adj[:] = 0
                         self._pipe_resets.clear()
+                        # The full upload below covers every slot.
+                        self._stream_dirty.clear()
                     policy.stream_begin(snap)
                     chain_ok = True
                 # Apply whatever has landed; never hold more than
@@ -1068,8 +1079,19 @@ class TaskDispatcher:
                     window_drains += 1
                     tickets.popleft()
                     continue
-                work, descr, snap, gen, adj, resets, lid = launch
-                ticket = policy.stream_launch(snap, descr, adj, resets)
+                work, descr, snap, gen, adj, resets, lid, dirty = launch
+                # The host-side cost of the policy stage.  In resident
+                # mode this is delta assembly + an async launch — the
+                # "policy near zero" target the stage budget tracks;
+                # the device round-trip itself is pipelined away.
+                t_pol = self._clock.now()
+                if getattr(policy, "supports_resident", False):
+                    ticket = policy.stream_launch(snap, descr, adj,
+                                                  resets, dirty=dirty)
+                else:
+                    ticket = policy.stream_launch(snap, descr, adj, resets)
+                self.stage_timer.record("policy",
+                                        self._clock.now() - t_pol)
                 launch = None          # appended below: rollback claim ends
                 # The prepared-snapshot lease rides the ticket: the
                 # launch's device uploads may still be reading the
@@ -1214,16 +1236,74 @@ class TaskDispatcher:
         self._pipe_launch_seq += 1
         for slot in resets:
             self._pipe_reset_barrier[slot] = lid
+        # Dirty-slot take happens HERE — the same locked region that
+        # published the snapshot — so the delta a resident policy
+        # gathers from the leased snapshot covers exactly these slots.
+        dirty = sorted(self._stream_dirty)
+        self._stream_dirty.clear()
         return (work, [tuple(d) for d in descr], snap, gen, adj,
-                resets, lid)
+                resets, lid, dirty)
 
     def _drain_ticket(self, ticket, work, snap_generation, lid,
                       snap=None) -> int:
+        """Collect one completed launch and apply its picks."""
+        return self.apply_stream_picks(
+            self._policy.stream_collect(ticket), work, snap_generation,
+            lid, snap)
+
+    # -- external stream driving (the fused shard router) -----------------
+    #
+    # The router's one-launch-for-N-shards cycle drives each shard's
+    # stream machinery from ITS thread: it prepares every shard's
+    # launch, runs ONE fused device step over the mesh, and routes each
+    # shard's picks back through apply_stream_picks — the SAME
+    # validation/issue/correction path the in-process pipelined loop
+    # uses, so grant bookkeeping semantics cannot fork.  Requires
+    # start_dispatch_thread=False (exactly one stream driver per
+    # dispatcher).
+
+    def begin_external_stream(self) -> PoolSnapshot:
+        """Arm the stream delta machinery (adj/reset/dirty tracking)
+        and return a full snapshot to seed the device chain from."""
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError(
+                    "external stream driving needs "
+                    "start_dispatch_thread=False: the dispatch thread "
+                    "already drives this dispatcher's stream")
+            self._pipe_active = True
+            self._pipe_adj[:] = 0
+            self._pipe_resets.clear()
+            self._stream_dirty.clear()
+            return self._snapshot_full_locked()
+
+    def prepare_stream_launch(self):
+        """One locked launch preparation: (work, descr, snap, gen, adj,
+        resets, lid, dirty) or None when nothing is launchable.  The
+        snapshot lease rides the tuple until apply_stream_picks (pass
+        it as `snap=`) or release_stream_launch."""
+        with self._lock:
+            return self._select_stream_work_locked()
+
+    def release_stream_launch(self, launch) -> None:
+        """Roll back a prepared launch that never reached the device
+        (mirror of the pipelined loop's error path)."""
+        with self._lock:
+            work, _, snap, _, _, _, _, _ = launch
+            self._release_snapshot_locked(snap)
+            for req, is_prefetch in work:
+                if is_prefetch:
+                    req.inflight_pre -= 1
+                    req.prefetch_launched = False
+                else:
+                    req.inflight_imm -= 1
+
+    def apply_stream_picks(self, picks, work, snap_generation, lid,
+                           snap=None) -> int:
         """Apply one completed launch: validate each pick against
         current state, issue grants, and convert host rejections into
         running-chain corrections for the next launch."""
         t0 = self._clock.now()
-        picks = self._policy.stream_collect(ticket)
         issued = 0
         cap_cache: Dict[int, Optional[Tuple[int, int, int]]] = {}
         with self._lock:
@@ -1393,6 +1473,8 @@ class TaskDispatcher:
     def _mark_slot_dirty_locked(self, slot: int) -> None:
         for buf in self._snap_buffers:
             buf.dirty.add(slot)
+        if self._pipe_active:
+            self._stream_dirty.add(slot)
 
     def _effective_capacity_at_locked(self, idx: np.ndarray) -> np.ndarray:
         """Vectorized _effective_capacity_locked over a slot index
@@ -1577,4 +1659,10 @@ class TaskDispatcher:
                 # Grant-path stage percentiles (doc/scheduler.md,
                 # "Grant-path stage budget").
                 "latency_breakdown": self.stage_timer.percentiles(),
+                # Stream health (stale-stream guard resyncs, last seen
+                # epoch; resident policies add their device-pool
+                # counters — seeds/full_syncs/oracle_*).
+                "stream": (self._policy.stream_stats()
+                           if hasattr(self._policy, "stream_stats")
+                           else {}),
             }

@@ -1405,6 +1405,290 @@ def run_ab(args) -> dict:
     }
 
 
+# -- device-resident dispatch (doc/scheduler.md "Device-resident
+# dispatch"): the A/B and CI gate for the fused one-launch control
+# plane, where the concatenated pool lives on the device mesh and N
+# per-shard policy calls become ONE sharded launch. --------------------
+
+
+def _build_resident_rig(n_shards: int, n_servants: int, width: int,
+                        policy_name: str, cap_sampler, rng,
+                        fused: bool, oracle_interval: int = 32):
+    """A ShardRouter with thread-less shards (external stream driving)
+    and the virtual fleet registered.  `fused=True` arms the
+    device-resident plane; `fused=False` is the host-loop control arm
+    (each shard's own policy, N sync cycles per sweep)."""
+    from ..scheduler.policy import make_policy
+    from ..scheduler.shard_router import ShardRouter
+    from ..scheduler.task_dispatcher import ServantInfo
+
+    router = ShardRouter.build(
+        lambda k: make_policy(policy_name, width),
+        n_shards, max_servants_per_shard=width,
+        batch_window_s=0.0, start_dispatch_thread=False)
+    for i in range(n_servants):
+        router.keep_servant_alive(ServantInfo(
+            location=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}:8335",
+            version=1, capacity=int(cap_sampler(rng)),
+            num_processors=8, memory_available=32 << 30,
+            dedicated=False,
+            env_digests=(f"env{i % 8}",)), 3600.0)
+    if fused:
+        router.enable_fused_dispatch(oracle_interval=oracle_interval)
+    return router
+
+
+def _drive_resident_cycles(router, cycles: int, demand: int, fused: bool,
+                           rng, cap_sampler, n_servants: int,
+                           churn_every: int = 4, warmup: int = 3,
+                           on_cycle=None) -> dict:
+    """The lock-step demand/cycle/free loop both A/B arms share: park
+    `demand` immediate grants per shard, run one control-plane sweep
+    (ONE fused launch, or N per-shard sync cycles), retire what
+    completed, churn a few servants' capacities (dirty slots -> the
+    fused arm's scatter deltas).  Returns throughput + cycle timing +
+    the full, order-preserving grant-id list (the double-issue check)."""
+    from ..scheduler.task_dispatcher import ServantInfo
+
+    # One persistent completion list, drained once per cycle: a
+    # partially-satisfied request delivers its grants on a LATER
+    # cycle's sweep, and they must still reach free_task.  Lock-step
+    # keeps this single-threaded (on_done fires inside our own sweep
+    # or submit calls).
+    got: list = []
+
+    def sweep():
+        if fused:
+            return router.run_fused_cycle()
+        return sum(d.run_dispatch_cycle_for_testing()
+                   for d in router.shards)
+
+    def submit(c):
+        for k, d in enumerate(router.shards):
+            d.submit_wait_for_starting_new_task(
+                f"env{(c + k) % 8}", immediate=demand,
+                timeout_s=30.0, on_done=got.extend)
+
+    def drain() -> list:
+        gids = [g for g, _ in got]
+        got.clear()
+        return gids
+
+    def churn(c):
+        # A trickle of capacity heartbeats: real fleet churn, and the
+        # thing the delta protocol exists for.
+        for _ in range(4):
+            i = int(rng.integers(0, n_servants))
+            router.keep_servant_alive(ServantInfo(
+                location=f"10.{i >> 16}.{(i >> 8) & 255}.{i & 255}:8335",
+                version=1, capacity=int(cap_sampler(rng)),
+                num_processors=8, memory_available=32 << 30,
+                dedicated=False,
+                env_digests=(f"env{i % 8}",)), 3600.0)
+
+    for c in range(warmup):            # compile + prime, untimed
+        submit(c)
+        sweep()
+        router.free_task(drain())
+
+    all_gids: list = []
+    cycle_s: list = []
+    issued_total = 0
+    t0 = time.perf_counter()
+    for c in range(cycles):
+        submit(c)
+        tc = time.perf_counter()
+        issued_total += sweep()
+        cycle_s.append(time.perf_counter() - tc)
+        gids = drain()
+        all_gids.extend(gids)
+        router.free_task(gids)
+        if churn_every and c % churn_every == churn_every - 1:
+            churn(c)
+        if on_cycle is not None:
+            on_cycle(c)
+    wall = time.perf_counter() - t0
+    cyc = np.array(cycle_s) * 1000.0
+    return {
+        "cycles": cycles,
+        "grants_issued": issued_total,
+        "grants_completed": len(all_gids),
+        "assignments_per_sec": round(issued_total / wall, 1),
+        "cycle_ms_p50": round(float(np.percentile(cyc, 50)), 3),
+        "cycle_ms_p99": round(float(np.percentile(cyc, 99)), 3),
+        "wall_seconds": round(wall, 3),
+        "grant_ids": all_gids,
+    }
+
+
+def run_device_ab(args) -> dict:
+    """The host-loop vs fused-resident A/B
+    (artifacts/pod_sim_device.json; doc/benchmarks.md "Device-resident
+    dispatch"): the SAME fleet, demand, and churn trickle through two
+    control planes —
+
+    A. host loop: each shard's own jax_grouped policy, N sync dispatch
+       cycles per sweep (the PR 9 shape: per-cycle pool upload, one
+       launch per shard);
+    B. fused resident: ONE sharded launch over the device mesh per
+       sweep, the concatenated pool device-resident across cycles,
+       churn arriving as scatter deltas, every shard's picks applied
+       through its unmodified grant bookkeeping.
+
+    On this harness both planes run on a single CPU host, so the A/B
+    prices the mechanics (per-sweep launch count, upload bytes), not
+    the accelerator — the regime label says which reading applies."""
+    import jax
+
+    shards = args.shards if args.shards > 1 else 8
+    servants = args.servants if args.servants != 512 else 50000
+    per = (servants + shards - 1) // shards
+    # PR 9's hash-imbalance sizing: consistent-hash shards don't split
+    # the fleet exactly evenly.
+    width = max(256, (per * 10 // 8 + 64 + 255) // 256 * 256)
+    demand = max(8, args.pump_batch // 2)
+    cycles = max(20, min(300, args.tasks // (demand * shards)))
+    cap = parse_capacity_dist(args.capacity_dist, args.capacity)
+
+    out: dict = {
+        "metric": "pod_sim_device_resident_ab",
+        "shards": shards, "servants": servants,
+        "shard_width": width, "demand_per_shard_cycle": demand,
+        "rtt_regime": ("host" if jax.devices()[0].platform != "tpu"
+                       else "device"),
+    }
+    for arm, fused, policy in (("host_loop", False, "jax_grouped"),
+                               ("fused_resident", True, "greedy_cpu")):
+        print(f"== {arm}: {shards} shards x {servants} servants, "
+              f"{cycles} cycles ==", flush=True)
+        rng = np.random.default_rng(11)
+        router = _build_resident_rig(shards, servants, width, policy,
+                                     cap, rng, fused=fused)
+        try:
+            res = _drive_resident_cycles(router, cycles, demand, fused,
+                                         rng, cap, servants)
+            gids = res.pop("grant_ids")
+            res["duplicate_grant_ids"] = len(gids) - len(set(gids))
+            if fused:
+                res["fused"] = router.fused_stats()
+                res["policy"] = "resident_control_plane_step"
+            else:
+                res["policy"] = policy
+            out[arm] = res
+        finally:
+            router.stop()
+    a, b = out["host_loop"], out["fused_resident"]
+    if a["assignments_per_sec"]:
+        out["fused_vs_host_loop_speedup"] = round(
+            b["assignments_per_sec"] / a["assignments_per_sec"], 2)
+    out["_meta"] = {
+        "rig": "single-process lock-step sweeps; both arms share the "
+               "demand/free/churn loop, only the control plane "
+               "differs.  On a CPU host the fused arm's win is "
+               "per-sweep launch count and upload bytes, not device "
+               "compute — on a TPU-attached deployment the host loop "
+               "additionally pays a tunnel round-trip per shard per "
+               "sweep.",
+    }
+    return out
+
+
+def smoke_device(args) -> int:
+    """CI gate (tools/ci.sh: `pod_sim --device-resident --smoke`): a
+    small fused-resident run asserting the device plane's correctness
+    invariants, lock-step so every launch is exactly reconstructable:
+
+    * every shard's picks each cycle == greedy_assign_reference run on
+      that shard's launch snapshot (per-descriptor-run multisets — the
+      grouped kernel permutes within a run of identical requests);
+    * the advanced device running slice == the reference's mutated
+      running (the fused fold + in-kernel grant delta agree with the
+      host's authoritative bookkeeping);
+    * no grant id is ever double-issued;
+    * the per-cycle statics oracle (interval=1) never trips."""
+    from ..models.cost import DEFAULT_COST_MODEL
+    from ..ops.assignment import greedy_assign_reference
+
+    shards = args.shards if args.shards > 1 else 4
+    servants, width, demand, cycles = 128, 256, 16, 20
+    rng = np.random.default_rng(23)
+    cap = parse_capacity_dist("uniform:2:6", 4)
+    router = _build_resident_rig(shards, servants, width, "greedy_cpu",
+                                 cap, rng, fused=True, oracle_interval=1)
+    cm = getattr(router.shards[0]._policy, "_cm", DEFAULT_COST_MODEL)
+    failures: list = []
+    parity_runs = [0]
+    per = router.shards[0].max_servants
+
+    def check_cycle(c):
+        fused = router._fused
+        dev_running = np.asarray(fused["pool"].running)
+        for entry in fused.get("last_cycle", ()):
+            k = entry["shard"]
+            work, descr, snap, gen, adj, resets, lid, dirty = \
+                entry["launch"]
+            picks = entry["picks"]
+            pool_np = {
+                "alive": snap.alive.copy(),
+                "capacity": snap.capacity.astype(np.int64).copy(),
+                "running": snap.running.astype(np.int64).copy(),
+                "dedicated": snap.dedicated.copy(),
+                "version": snap.version.copy(),
+                "env_bitmap": snap.env_bitmap.copy(),
+            }
+            tasks = []
+            for env, mv, avoid, count in descr:
+                tasks.extend([(env, mv, avoid)] * count)
+            ref = greedy_assign_reference(pool_np, tasks, cm)
+            off = 0
+            for env, mv, avoid, count in descr:
+                if sorted(picks[off:off + count]) != \
+                        sorted(ref[off:off + count]):
+                    failures.append(
+                        f"cycle {c} shard {k}: picks diverge from "
+                        f"greedy_assign_reference in run env={env} "
+                        f"(dev={sorted(picks[off:off + count])} "
+                        f"ref={sorted(ref[off:off + count])})")
+                    return
+                parity_runs[0] += 1
+                off += count
+            if not np.array_equal(dev_running[k * per:(k + 1) * per],
+                                  pool_np["running"]):
+                failures.append(
+                    f"cycle {c} shard {k}: device running slice "
+                    "diverges from the reference's bookkeeping")
+
+    res = _drive_resident_cycles(router, cycles, demand, True, rng, cap,
+                                 servants, churn_every=3,
+                                 on_cycle=check_cycle)
+    gids = res.pop("grant_ids")
+    stats = router.fused_stats() or {}
+    router.stop()
+    dupes = len(gids) - len(set(gids))
+    if res["grants_issued"] <= 0:
+        failures.append("fused plane issued no grants")
+    if parity_runs[0] <= 0:
+        failures.append("parity oracle never saw a launch")
+    if dupes:
+        failures.append(f"DOUBLE-ISSUED grant ids: {dupes}")
+    if stats.get("oracle_mismatches"):
+        failures.append(
+            f"statics oracle tripped {stats['oracle_mismatches']}x")
+    if not stats.get("oracle_checks"):
+        failures.append("statics oracle never ran at interval=1")
+    print(json.dumps({
+        "smoke": "pod_sim_device_resident",
+        "shards": shards,
+        "cycles": res["cycles"],
+        "grants_issued": res["grants_issued"],
+        "parity_runs_checked": parity_runs[0],
+        "duplicate_grant_ids": dupes,
+        "fused": stats,
+        "failures": failures,
+    }, indent=2))
+    return 1 if failures else 0
+
+
 def quick_sharded_assignments_per_sec() -> float:
     """bench.py harness v8 canary: grants/s through a small 4-shard
     router (hotspot-free, steal armed) on the full RPC grant path."""
@@ -1521,6 +1805,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="pump-rig: aggregate grant calls/s across "
                          "pumps (0 = flood; a latency claim needs a "
                          "below-saturation rate)")
+    ap.add_argument("--device-resident", action="store_true",
+                    help="fused device-resident control plane "
+                         "(doc/scheduler.md \"Device-resident "
+                         "dispatch\"): alone = host-loop vs "
+                         "fused-resident A/B "
+                         "(artifacts/pod_sim_device.json), with "
+                         "--smoke = the picks-parity CI gate against "
+                         "greedy_assign_reference")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: small sharded hotspot run with "
                          "invariant assertions (exit 1 on violation)")
@@ -1569,16 +1861,30 @@ def main() -> None:
     # The device-sharded load summary wants one (virtual) device per
     # shard; on a CPU host that is free, but the flag must land before
     # the first jax import.
-    if args.shards > 1 and "jax" not in sys.modules \
-            and args.mesh_loads != "off":
+    n_dev = args.shards
+    if args.device_resident:
+        # The fused plane NEEDS one (virtual) device per shard — force
+        # the count regardless of --mesh-loads, using the same default
+        # geometry run_device_ab/smoke_device will pick.
+        n_dev = args.shards if args.shards > 1 else \
+            (4 if args.smoke else 8)
+    if n_dev > 1 and "jax" not in sys.modules \
+            and (args.device_resident or args.mesh_loads != "off"):
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count="
-                f"{args.shards}").strip()
+                f"{n_dev}").strip()
+    if args.device_resident and args.smoke:
+        sys.exit(smoke_device(args))
     if args.smoke:
         sys.exit(smoke(args))
-    if args.pump_rig:
+    if args.device_resident:
+        out = run_device_ab(args)
+        if args.out is None:
+            args.out = "artifacts/pod_sim_device.json"
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    elif args.pump_rig:
         out = run_pump_rig_one(args)
     elif args.ab:
         out = run_ab(args)
