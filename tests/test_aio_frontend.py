@@ -26,19 +26,34 @@ from yadcc_tpu.rpc.aio_server import (
     AioRpcServer,
     AsyncAioChannel,
     BodyOverCap,
+    EventLoopThread,
     FrameStreamParser,
     HttpStreamParser,
+    LoopTimer,
     ProtocolError,
     make_request_payload,
     split_request_payload,
     _envelope_segments,
 )
 from yadcc_tpu.rpc.transport import RpcError, encode_frame
+from yadcc_tpu.utils import looplag
 
 
 def _envelope(seq: int, service: str, method: str, frame: bytes) -> bytes:
     return b"".join(_envelope_segments(
         seq, make_request_payload(service, method, frame)))
+
+
+@pytest.fixture(autouse=True)
+def _loop_lag_guard():
+    """The dynamic half of the await-under-lock rule: every test in
+    this module runs under the loop-lag watchdog, so a handler that
+    blocks a serving loop >250ms fails the test that caused it rather
+    than showing up as an unrelated timeout three tests later."""
+    with looplag.installed() as session:
+        yield session
+    assert not session.violations, "; ".join(
+        v.render() for v in session.violations)
 
 
 # ---------------------------------------------------------------------------
@@ -608,6 +623,136 @@ class TestAsyncComponentApis:
             assert len(more) == 1
         finally:
             d.stop()
+
+
+# ---------------------------------------------------------------------------
+# reply-once at runtime: double replies are refused AND counted
+# ---------------------------------------------------------------------------
+
+
+class TestReplyOnceRuntime:
+    def test_http_double_reply_refused_and_counted(self):
+        outcomes = []
+
+        def handler(responder):
+            outcomes.append(responder._reply(200, b'{"first":1}'))
+            outcomes.append(responder._reply(500, b'{"second":1}'))
+
+        srv = AioHttpServer(handler, "127.0.0.1:0")
+        try:
+            st, body, _ = _post(srv.port, "/x", b"{}")
+            assert st == 200 and b"first" in body
+            assert outcomes == [True, False]
+            assert srv.inspect()["double_replies"] == 1
+        finally:
+            srv.stop()
+
+    def test_http_raise_after_reply_does_not_fire_500(self):
+        def handler(responder):
+            responder._reply(200, b'{"ok":1}')
+            raise RuntimeError("after reply")
+
+        srv = AioHttpServer(handler, "127.0.0.1:0")
+        try:
+            st, body, _ = _post(srv.port, "/x", b"{}")
+            assert st == 200 and b"ok" in body
+            # The raise-path 500 checked .replied first: no double.
+            assert srv.inspect()["double_replies"] == 0
+        finally:
+            srv.stop()
+
+    def test_http_raise_before_reply_fires_500(self):
+        def handler(responder):
+            raise RuntimeError("boom")
+
+        srv = AioHttpServer(handler, "127.0.0.1:0")
+        try:
+            st, _, _ = _post(srv.port, "/x", b"{}")
+            assert st == 500
+            assert srv.inspect()["double_replies"] == 0
+        finally:
+            srv.stop()
+
+    def test_rpc_parked_double_fire_refused_and_counted(self):
+        spec = ServiceSpec("t.Park")
+
+        def handler(req, att, ctx, done):
+            done(api.scheduler.GetConfigResponse(
+                serving_daemon_token="first"))
+            done(api.scheduler.GetConfigResponse(
+                serving_daemon_token="second"))
+
+        spec.add_parked("Do", api.scheduler.GetConfigRequest, handler)
+        srv = AioRpcServer("127.0.0.1:0")
+        srv.add_service(spec)
+        try:
+            ch = Channel(f"aio://127.0.0.1:{srv.port}")
+            resp, _ = ch.call("t.Park", "Do",
+                              api.scheduler.GetConfigRequest(),
+                              api.scheduler.GetConfigResponse,
+                              timeout=10)
+            assert resp.serving_daemon_token == "first"
+            ch.close()
+            assert srv.inspect()["double_replies"] == 1
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# LoopTimer: the thread-safe deadline-cancel handle
+# ---------------------------------------------------------------------------
+
+
+class TestLoopTimer:
+    @pytest.fixture
+    def loops(self):
+        lt = EventLoopThread(name="looptimer-test")
+        yield lt
+        lt.stop()
+
+    def _wait_for(self, pred, timeout_s=5.0):
+        deadline = time.monotonic() + timeout_s
+        while not pred() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return pred()
+
+    def test_fires_when_not_cancelled(self, loops):
+        fired = []
+        timer = LoopTimer(loops)
+        loops.call_soon(timer._arm, 0.02, fired.append, (1,))
+        assert self._wait_for(lambda: fired == [1])
+        assert not timer.cancelled
+
+    def test_cancel_before_arm_hop_suppresses(self, loops):
+        fired = []
+        timer = LoopTimer(loops)
+        timer.cancel()  # wins the race against the call_soon hop
+        loops.call_soon(timer._arm, 0.01, fired.append, (2,))
+        time.sleep(0.2)
+        assert fired == [] and timer.cancelled
+
+    def test_cancel_after_arm_kills_timer(self, loops):
+        fired = []
+        timer = LoopTimer(loops)
+        loops.call_soon(timer._arm, 0.3, fired.append, (3,))
+        # Let the arm land on the loop before cancelling.
+        self._wait_for(lambda: timer._handle is not None)
+        timer.cancel()
+        time.sleep(0.5)
+        assert fired == [] and timer.cancelled
+
+    def test_server_call_later_returns_cancellable(self):
+        srv = AioHttpServer(lambda r: r._reply(200), "127.0.0.1:0")
+        try:
+            fired = []
+            t1 = srv.call_later(0.02, fired.append, 1)
+            assert self._wait_for(lambda: fired == [1])
+            t2 = srv.call_later(30.0, fired.append, 2)
+            t2.cancel()
+            assert isinstance(t1, LoopTimer) and t2.cancelled
+            assert fired == [1]
+        finally:
+            srv.stop()
 
 
 # ---------------------------------------------------------------------------

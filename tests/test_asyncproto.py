@@ -1,0 +1,442 @@
+"""ytpu-analyze v3: the async-protocol families (analysis/asyncproto.py)
+and the SARIF export (analysis/sarif.py).
+
+Same layering as test_analysis.py:
+
+1. Fixture snippets per family — seeded violation caught (TP),
+   disciplined twin clean (TN), ``# ytpu: allow(...)`` honored.
+2. Interprocedural reply-once: a hand-off chain whose receiving
+   parameter lacks the ``responder`` declaration is itself the finding.
+3. Has-teeth: the real parked serving surface (rpc/scheduler/daemon)
+   carries the annotations the families key on.  The package-wide
+   zero-unsuppressed gate lives in test_analysis.py and covers these
+   families automatically.
+4. SARIF: document shape + to_sarif/from_sarif round-trip + the
+   ``--sarif`` CLI flag.
+
+The two genuine defects this pack surfaced on landing — dropped
+``call_later`` deadline-timer handles in http_service's parked quota
+and task-wait routes — regress through the async-lifecycle fixtures
+below (the exact Expr-dropped / thunk-discarded shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+from yadcc_tpu.analysis import AnalyzerConfig, analyze_paths
+from yadcc_tpu.analysis import sarif
+from yadcc_tpu.analysis.core import _LOOP_ONLY_RE, _RESPONDER_RE, RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "yadcc_tpu")
+
+ASYNC_RULES = ("reply-drop", "reply-double", "reply-handoff",
+               "await-under-lock", "loop-affinity",
+               "async-timer-leak", "async-task-orphan")
+
+
+def run_snippet(tmp_path, code, subdir="scheduler", **cfg):
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "mod.py").write_text(textwrap.dedent(code))
+    config = AnalyzerConfig(lock_ranks={}, **cfg)
+    findings, stats = analyze_paths([str(tmp_path)], config)
+    return findings, stats
+
+
+def live(findings, rule=None):
+    return [f for f in findings
+            if not f.suppressed and (rule is None or f.rule == rule)]
+
+
+def test_rule_catalog_has_async_families():
+    for rule in ASYNC_RULES:
+        assert rule in RULES
+
+
+# ---------------------------------------------------------------------------
+# reply-once
+# ---------------------------------------------------------------------------
+
+
+REPLY_SNIPPET = """
+def tp_drop_on_else(req, done):  # ytpu: responder(done)
+    if req:
+        done(1)
+
+def tn_replies_all_paths(req, done):  # ytpu: responder(done)
+    if req:
+        done(1)
+        return
+    done(0)
+
+def tp_double_fire(done):  # ytpu: responder(done)
+    done(1)
+    done(2)
+
+def tn_raise_is_legal_completion(req, done):  # ytpu: responder(done)
+    if not req:
+        raise ValueError("bad request")
+    done(req)
+
+def tn_replied_guard(resp):  # ytpu: responder(resp)
+    if resp.replied:
+        return
+    resp.reply(200)
+
+def tn_guard_in_or_chain(resp, result):  # ytpu: responder(resp)
+    if resp.replied or result is None:
+        return
+    resp.send_result(result)
+
+def tp_suppressed(req, done):  # ytpu: responder(done)  # ytpu: allow(reply-drop)  # caller replies on falsy req
+    if req:
+        done(1)
+
+def tp_bad_decl(req):  # ytpu: responder(nope)
+    return req
+
+def tn_constructor_handoff(done):  # ytpu: responder(done)
+    waiter = _Waiter(on_done=done)
+    return waiter
+
+class _Waiter:
+    def __init__(self, on_done):
+        self.on_done = on_done
+"""
+
+
+def test_reply_once_fixtures(tmp_path):
+    findings, _ = run_snippet(tmp_path, REPLY_SNIPPET)
+    drops = live(findings, "reply-drop")
+    assert len(drops) == 2  # tp_drop_on_else + the bad declaration
+    assert any("tp_drop_on_else" in f.message for f in drops)
+    assert any("names no parameter" in f.message for f in drops)
+    doubles = live(findings, "reply-double")
+    assert len(doubles) == 1
+    assert "tp_double_fire" in doubles[0].message
+    # TNs stay clean; the seeded suppression is honored.
+    for f in drops + doubles:
+        assert "tn_" not in f.message
+    sup = [f for f in findings if f.suppressed and f.rule == "reply-drop"]
+    assert len(sup) == 1
+
+
+REPLY_CHAIN_TP = """
+def finish_request(outcome, sink):
+    sink.fire(outcome)
+
+def tp_hands_off_to_undeclared(req, done):  # ytpu: responder(done)
+    finish_request(req, done)
+"""
+
+REPLY_CHAIN_TN = """
+def finish_request(outcome, sink):  # ytpu: responder(sink)
+    sink.fire(outcome)
+
+def tn_hands_off_to_declared(req, done):  # ytpu: responder(done)
+    finish_request(req, done)
+
+class Svc:
+    def tn_seam_handoff(self, resp):  # ytpu: responder(resp)
+        self.pool.submit(self._finish, resp)
+
+    def _finish(self, resp):  # ytpu: responder(resp)
+        resp.send_result(b"ok")
+"""
+
+
+def test_reply_handoff_interprocedural(tmp_path):
+    findings, _ = run_snippet(tmp_path, REPLY_CHAIN_TP)
+    handoffs = live(findings, "reply-handoff")
+    assert len(handoffs) == 1
+    assert "finish_request" in handoffs[0].message
+    assert "responder(sink)" in handoffs[0].message
+    assert not live(findings, "reply-drop")  # the hand-off is the reply
+
+
+def test_reply_handoff_declared_chain_is_clean(tmp_path):
+    findings, _ = run_snippet(tmp_path, REPLY_CHAIN_TN)
+    assert not live(findings)
+
+
+def test_reply_rules_scoped_to_serving_tree(tmp_path):
+    # The same dropped-reply shape outside rpc/scheduler/daemon is not
+    # this pack's business.
+    findings, _ = run_snippet(tmp_path, REPLY_SNIPPET, subdir="common")
+    assert not live(findings, "reply-drop")
+    assert not live(findings, "reply-double")
+
+
+# ---------------------------------------------------------------------------
+# await-under-lock
+# ---------------------------------------------------------------------------
+
+
+AWAIT_SNIPPET = """
+import asyncio
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+
+    async def tp_await_while_held(self):
+        with self._lock:
+            await asyncio.sleep(0)
+
+    async def tn_await_after_release(self):
+        with self._lock:
+            x = 1
+        await asyncio.sleep(0)
+
+    async def tn_asyncio_lock_is_fine(self):
+        async with self._alock:
+            await asyncio.sleep(0)
+
+    async def tp_locked_convention(self):
+        await asyncio.sleep(0)
+
+    async def tp_suppressed(self):
+        with self._lock:
+            await asyncio.sleep(0)  # ytpu: allow(await-under-lock)  # startup only, loop not serving yet
+"""
+
+
+def test_await_under_lock_fixtures(tmp_path):
+    findings, _ = run_snippet(tmp_path, AWAIT_SNIPPET, subdir="rpc")
+    tps = live(findings, "await-under-lock")
+    assert len(tps) == 1
+    assert "_lock" in tps[0].message
+    sup = [f for f in findings
+           if f.suppressed and f.rule == "await-under-lock"]
+    assert len(sup) == 1
+
+
+AWAIT_LOCKED_CONVENTION = """
+import asyncio
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def _flush_locked(self):
+        await asyncio.sleep(0)
+"""
+
+
+def test_await_in_locked_convention_method(tmp_path):
+    findings, _ = run_snippet(tmp_path, AWAIT_LOCKED_CONVENTION,
+                              subdir="daemon")
+    assert len(live(findings, "await-under-lock")) == 1
+
+
+# ---------------------------------------------------------------------------
+# loop-affinity
+# ---------------------------------------------------------------------------
+
+
+AFFINITY_SNIPPET = """
+class Front:
+    # ytpu: loop-only
+    def _send(self, data):
+        self.transport.write(data)
+
+    def tp_pool_calls_loop_only(self, data):
+        self._send(data)
+
+    def tn_threadsafe_hop(self, data):
+        self.loop.call_soon_threadsafe(self._send, data)
+
+    async def tn_async_def_is_loop_context(self, data):
+        self._send(data)
+
+    def tp_pool_arms_timer(self, fn):
+        h = self.loop.call_later(1.0, fn)
+        return h
+
+    def tn_thunk_runs_on_loop(self, fn):
+        def _arm():
+            self._timer = self.loop.call_later(1.0, fn)
+        self.loop.call_soon_threadsafe(_arm)
+
+    def tp_future_settled_off_loop(self, fut):
+        fut.set_result(1)
+
+    def tn_future_settled_through_seam(self, fut):
+        self.loop.call_soon_threadsafe(fut.set_result, 1)
+
+    def tp_suppressed(self, data):
+        self._send(data)  # ytpu: allow(loop-affinity)  # single-threaded startup, loop not running
+"""
+
+
+def test_loop_affinity_fixtures(tmp_path):
+    findings, _ = run_snippet(tmp_path, AFFINITY_SNIPPET, subdir="rpc")
+    tps = live(findings, "loop-affinity")
+    assert len(tps) == 3
+    msgs = "\n".join(f.message for f in tps)
+    assert "'_send'" in msgs
+    assert "'call_later'" in msgs
+    assert "set_result" in msgs
+    sup = [f for f in findings
+           if f.suppressed and f.rule == "loop-affinity"]
+    assert len(sup) == 1
+    assert not live(findings, "async-timer-leak")  # retained or stored
+
+
+# ---------------------------------------------------------------------------
+# async-lifecycle
+# ---------------------------------------------------------------------------
+
+
+LIFECYCLE_SNIPPET = """
+import asyncio
+
+class Timers:
+    # ytpu: loop-only
+    def tp_dropped_handle(self, fn):
+        self.loop.call_later(5.0, fn)
+
+    # ytpu: loop-only
+    def tn_retained_and_cancelled(self, fn):
+        handle = self.loop.call_later(5.0, fn)
+        handle.cancel()
+
+    # ytpu: loop-only
+    def tp_leaked_local(self, fn):
+        handle = self.loop.call_later(5.0, fn)
+        self.log("armed")
+
+    # ytpu: loop-only
+    def tn_stored_on_owner(self, fn):
+        self._deadline = self.loop.call_later(5.0, fn)
+
+    # ytpu: loop-only
+    def tn_returned_to_caller(self, fn):
+        handle = self.loop.call_later(5.0, fn)
+        return handle
+
+    # ytpu: loop-only
+    def tn_handed_to_container(self, fn, box):
+        handle = self.loop.call_later(5.0, fn)
+        box.append(handle)
+
+    async def tp_orphaned_task(self, coro):
+        asyncio.create_task(coro)
+
+    async def tn_awaited_task(self, coro):
+        task = asyncio.create_task(coro)
+        await task
+
+    # ytpu: loop-only
+    def tp_thunk_discards_handle(self, fn):
+        self.loop.call_soon(lambda: self.loop.call_later(5.0, fn))
+
+    # ytpu: loop-only
+    def tp_suppressed(self, fn):
+        self.loop.call_later(5.0, fn)  # ytpu: allow(async-timer-leak)  # process-lifetime reclaim tick
+"""
+
+
+def test_async_lifecycle_fixtures(tmp_path):
+    findings, _ = run_snippet(tmp_path, LIFECYCLE_SNIPPET,
+                              subdir="daemon")
+    leaks = live(findings, "async-timer-leak")
+    assert len(leaks) == 3  # dropped, leaked-local, thunk-discarded
+    msgs = "\n".join(f.message for f in leaks)
+    assert "dropped" in msgs
+    assert "never" in msgs  # the leaked-local path
+    assert "discarded by the scheduling thunk" in msgs
+    orphans = live(findings, "async-task-orphan")
+    assert len(orphans) == 1
+    sup = [f for f in findings
+           if f.suppressed and f.rule == "async-timer-leak"]
+    assert len(sup) == 1
+    assert not live(findings, "loop-affinity")  # all in loop context
+
+
+def test_asyncproto_in_per_family_timings(tmp_path):
+    _, stats = run_snippet(tmp_path, LIFECYCLE_SNIPPET, subdir="daemon")
+    assert "asyncproto" in stats["timings"]
+
+
+# ---------------------------------------------------------------------------
+# has-teeth: the real parked surface carries the annotations
+# ---------------------------------------------------------------------------
+
+
+def _count_directives(pattern):
+    per_subsystem = {}
+    for sub in ("rpc", "scheduler", "daemon"):
+        total = 0
+        for root, _, files in os.walk(os.path.join(PKG_DIR, sub)):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                with open(os.path.join(root, fn),
+                          encoding="utf-8") as fp:
+                    total += len(pattern.findall(fp.read()))
+        if total:
+            per_subsystem[sub] = total
+    return per_subsystem
+
+
+def test_parked_surface_declares_responders():
+    decls = _count_directives(_RESPONDER_RE)
+    assert sum(decls.values()) >= 6
+    # The declarations span subsystems — rpc front end, scheduler
+    # parked grants, daemon long-poll routes — not one lucky file.
+    assert set(decls) >= {"rpc", "scheduler", "daemon"}
+
+
+def test_serving_loop_surface_declares_loop_only():
+    decls = _count_directives(_LOOP_ONLY_RE)
+    assert decls.get("rpc", 0) >= 5
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_shape_and_roundtrip(tmp_path):
+    findings, _ = run_snippet(tmp_path, REPLY_SNIPPET)
+    assert live(findings) and any(f.suppressed for f in findings)
+    doc = json.loads(json.dumps(sarif.to_sarif(findings)))
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "ytpu-analyze"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert set(ASYNC_RULES) <= rule_ids == set(RULES)
+    # Suppression state travels as SARIF's own notion.
+    by_sup = [r for r in doc["runs"][0]["results"]
+              if r.get("suppressions")]
+    assert len(by_sup) == sum(1 for f in findings if f.suppressed)
+    back = sarif.from_sarif(doc)
+    assert {(f.rule, f.path, f.line, f.message, f.suppressed)
+            for f in back} == \
+           {(f.rule, f.path, f.line, f.message, f.suppressed)
+            for f in findings}
+
+
+def test_sarif_cli_flag(tmp_path):
+    from yadcc_tpu.analysis.__main__ import main
+
+    d = tmp_path / "scheduler"
+    d.mkdir(parents=True)
+    (d / "mod.py").write_text(textwrap.dedent(REPLY_SNIPPET))
+    out = tmp_path / "report.sarif"
+    rc = main([str(tmp_path), "--sarif", str(out), "--no-cache"])
+    assert rc == 1  # findings present
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert any(r["ruleId"] == "reply-double" for r in results)
+    assert all(r["locations"][0]["physicalLocation"]["region"]
+               ["startLine"] > 0 for r in results)

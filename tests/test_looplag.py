@@ -1,0 +1,99 @@
+"""Loop-lag watchdog (utils/looplag.py) — the runtime companion of the
+await-under-lock static rule.
+
+Kept in its own module because these tests stall loops ON PURPOSE; the
+autouse guard in test_aio_frontend.py would (correctly) fail them.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from yadcc_tpu.rpc.aio_server import EventLoopThread
+from yadcc_tpu.utils import looplag
+
+
+def _wait_for(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pred()
+
+
+class TestLoopLagWatchdog:
+    def test_healthy_loop_is_clean(self):
+        loops = EventLoopThread(name="healthy-loop")
+        try:
+            with looplag.installed(threshold_s=0.2,
+                                   interval_s=0.02) as session:
+                # Plenty of loop turns; none stalls.
+                for _ in range(5):
+                    loops.run_sync(_async_noop())
+                    time.sleep(0.05)
+            assert session.violations == []
+        finally:
+            loops.stop()
+
+    def test_stalled_loop_is_flagged_with_name(self):
+        loops = EventLoopThread(name="stall-victim")
+        try:
+            with looplag.installed(threshold_s=0.1,
+                                   interval_s=0.02) as session:
+                # A blocking call ON the loop thread: exactly the defect
+                # class the static rule cannot see (C extension, sync
+                # I/O inside a handler...).
+                loops.loop.call_soon_threadsafe(time.sleep, 0.4)
+                assert _wait_for(lambda: session.violations)
+            assert any(v.loop_name == "stall-victim"
+                       for v in session.violations)
+            assert all(v.gap_s > 0.1 for v in session.violations)
+            assert "stalled" in session.violations[0].render()
+        finally:
+            loops.stop()
+
+    def test_loop_created_mid_session_is_watched(self):
+        with looplag.installed(threshold_s=0.1,
+                               interval_s=0.02) as session:
+            loops = EventLoopThread(name="late-arrival")
+            try:
+                loops.loop.call_soon_threadsafe(time.sleep, 0.4)
+                assert _wait_for(lambda: session.violations)
+            finally:
+                loops.stop()
+        assert any(v.loop_name == "late-arrival"
+                   for v in session.violations)
+
+    def test_stopped_loop_is_skipped_not_flagged(self):
+        loops = EventLoopThread(name="stopped-early")
+        with looplag.installed(threshold_s=0.05,
+                               interval_s=0.02) as session:
+            loops.stop()
+            time.sleep(0.3)  # well past threshold; loop is not running
+        assert session.violations == []
+
+    def test_nested_sessions_rejected(self):
+        with looplag.installed():
+            with pytest.raises(RuntimeError):
+                with looplag.installed():
+                    pass
+
+    def test_one_stall_reports_once_per_window(self):
+        loops = EventLoopThread(name="rebase-check")
+        try:
+            with looplag.installed(threshold_s=0.15,
+                                   interval_s=0.02) as session:
+                loops.loop.call_soon_threadsafe(time.sleep, 0.3)
+                assert _wait_for(lambda: session.violations)
+                time.sleep(0.1)
+            # Re-based after each report: a ~0.3s stall at a 0.15s
+            # threshold yields one or two reports, not one per 20ms
+            # watcher turn (which would be ~15).
+            assert 1 <= len(session.violations) <= 3
+        finally:
+            loops.stop()
+
+
+async def _async_noop():
+    return None

@@ -15,15 +15,18 @@ cd "$(dirname "$0")/.." || exit 1
 fail=0
 
 echo "== lint (ytpu-analyze + wire-compat + shellcheck) =="
-# The static concurrency/jit/taint/lifecycle/wire-compat analyzer must
-# come back clean — zero unsuppressed findings over the package
-# (doc/static_analysis.md).  The findings report ships as a CI
-# artifact, and the stage is wall-time-bounded so the content-hash
-# result cache regressing to cold-parse speed is itself a failure.
+# The static concurrency/jit/taint/lifecycle/async-protocol/wire-compat
+# analyzer must come back clean — zero unsuppressed findings over the
+# package (doc/static_analysis.md).  The findings report (with
+# per-family timings) ships as a CI artifact alongside a SARIF 2.1.0
+# export for code-annotation surfaces, and the stage is
+# wall-time-bounded so the content-hash result cache regressing to
+# cold-parse speed is itself a failure.
 mkdir -p artifacts
 lint_t0=$SECONDS
 if ! python -m yadcc_tpu.analysis yadcc_tpu --stats \
-       --json artifacts/ytpu_analyze.json; then
+       --json artifacts/ytpu_analyze.json \
+       --sarif artifacts/ytpu_analyze.sarif; then
   echo "ytpu-analyze FAILED" >&2
   fail=1
 fi

@@ -8,6 +8,7 @@ Incremental-rollout / performance surface:
     --baseline FILE         ignore findings recorded in FILE
     --write-baseline FILE   record current findings and exit 0
     --stats                 per-rule-family timing + cache hit rate
+    --sarif FILE            SARIF 2.1.0 report (CI code annotations)
     --no-cache / --cache P  content-hash result cache control
     --wire-golden FILE      golden wire descriptor (default: packaged)
     --update-wire-golden    re-pin the golden from api/gen and exit
@@ -53,6 +54,9 @@ def main(argv=None) -> int:
                          "lock_hierarchy.toml)")
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write the findings report to this path")
+    ap.add_argument("--sarif", dest="sarif_out", default=None,
+                    help="write a SARIF 2.1.0 report to this path "
+                         "(CI annotations)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
     ap.add_argument("--strict-suppressions", action="store_true",
@@ -185,6 +189,14 @@ def main(argv=None) -> int:
         }
         with open(args.json_out, "w", encoding="utf-8") as fp:
             json.dump(report, fp, indent=2, sort_keys=True)
+            fp.write("\n")
+
+    if args.sarif_out:
+        from . import sarif
+
+        with open(args.sarif_out, "w", encoding="utf-8") as fp:
+            json.dump(sarif.to_sarif(findings), fp, indent=2,
+                      sort_keys=True)
             fp.write("\n")
 
     return 1 if stats["findings"] else 0

@@ -71,6 +71,27 @@ RULES: Dict[str, str] = {
                    "descriptor (analysis/wire_golden.json)",
     "wire-unknown-field": "message constructed with a field name the "
                           "descriptor does not define",
+    "reply-drop": "a path through a responder-annotated handler or "
+                  "continuation neither replies, hands the responder "
+                  "off, nor raises (the parked client is dropped)",
+    "reply-double": "a reachable second direct reply on one execution "
+                    "path (double-fire into a settled stream)",
+    "reply-handoff": "responder handed to a resolvable callee whose "
+                     "receiving parameter is not declared "
+                     "# ytpu: responder(param)",
+    "await-under-lock": "await while a threading lock is held "
+                        "(lexically or via the *_locked convention): "
+                        "the whole event loop stalls behind the lock",
+    "loop-affinity": "loop-only method called, or loop-affine "
+                     "primitive (loop.call_later / create_task / "
+                     "Future.set_result) used, outside loop context "
+                     "without the call_soon_threadsafe seam",
+    "async-timer-leak": "loop timer handle dropped at creation or "
+                        "never cancelled / handed off: the timer "
+                        "outlives the continuation it guards",
+    "async-task-orphan": "asyncio task neither awaited, cancelled, "
+                         "retained nor handed off (orphaned tasks "
+                         "silently eat exceptions)",
     "suppression": "malformed suppression or suppression without a "
                    "written reason",
     "parse-error": "file could not be parsed",
@@ -110,6 +131,14 @@ _SANITIZES_RE = re.compile(r"#\s*ytpu:\s*sanitizes\(\s*([A-Za-z0-9_,\- ]*)\s*\)"
 _ACQUIRES_RE = re.compile(r"#\s*ytpu:\s*acquires\(\s*([A-Za-z0-9_,\- ]*)\s*\)")
 _UNTRUSTED_RE = re.compile(
     r"#\s*ytpu:\s*untrusted\(\s*([A-Za-z0-9_.,\s]*)\s*\)")
+# Async-protocol directives (asyncproto family).  Both ride the def
+# line the same way the trust-boundary directives do:
+#
+#   def WaitParked(self, req, att, ctx, done):  # ytpu: responder(done)
+#   def send_payload(self, seq, payload):       # ytpu: loop-only
+_RESPONDER_RE = re.compile(
+    r"#\s*ytpu:\s*responder\(\s*([A-Za-z0-9_,\s]*)\s*\)")
+_LOOP_ONLY_RE = re.compile(r"#\s*ytpu:\s*loop-only\b")
 
 
 @dataclass
@@ -169,6 +198,12 @@ class AnalyzerConfig:
     device_sync_path_fragments: Tuple[str, ...] = (
         "device_pool.py", "shard_router.py", "policy.py",
         "task_dispatcher.py")
+    # Path fragments selecting the modules where the async-protocol
+    # family (reply-once / await-under-lock / loop-affinity /
+    # async-lifecycle) applies: the three serving layers that host
+    # parked continuations.
+    asyncproto_path_fragments: Tuple[str, ...] = (
+        "rpc", "scheduler", "daemon")
     # Lock hierarchy: canonical lock name -> rank (lower acquired
     # first).  Loaded from lock_hierarchy.toml by the CLI.
     lock_ranks: Dict[str, int] = field(default_factory=dict)
@@ -187,6 +222,7 @@ class AnalyzerConfig:
                 "jit": list(self.jit_path_fragments),
                 "aio": list(self.aio_path_fragments),
                 "dsync": list(self.device_sync_path_fragments),
+                "asyncproto": list(self.asyncproto_path_fragments),
                 "ranks": dict(self.lock_ranks)}
 
 
@@ -209,6 +245,8 @@ class Directives:
         self.sanitizes: Dict[int, Set[str]] = {}   # lineno -> tags
         self.acquires: Dict[int, Set[str]] = {}    # lineno -> tags
         self.untrusted: Dict[int, List[str]] = {}  # lineno -> param specs
+        self.responders: Dict[int, List[str]] = {}  # lineno -> param names
+        self.loop_only: Set[int] = set()           # lineno set
         for lineno, text in enumerate(source.splitlines(), start=1):
             if "#" not in text:
                 continue
@@ -237,6 +275,13 @@ class Directives:
                 self.untrusted[lineno] = [t.strip()
                                           for t in u.group(1).split(",")
                                           if t.strip()]
+            r = _RESPONDER_RE.search(text)
+            if r:
+                self.responders[lineno] = [t.strip()
+                                           for t in r.group(1).split(",")
+                                           if t.strip()]
+            if _LOOP_ONLY_RE.search(text):
+                self.loop_only.add(lineno)
 
     def suppression_for(self, line: int, rule: str) -> Optional[Suppression]:
         s = self.suppressions.get(line)
@@ -337,6 +382,10 @@ class ModuleModel:
 def _factory_kind(call: ast.AST) -> Optional[str]:
     if not isinstance(call, ast.Call):
         return None
+    # asyncio.Lock() / asyncio.Condition() are loop primitives, not
+    # thread locks: holding one across an await is the normal idiom.
+    if root_segment(call.func) == "asyncio":
+        return None
     seg = last_segment(call.func)
     if seg in LOCK_FACTORIES:
         return "lock"
@@ -426,9 +475,14 @@ class FunctionInfo:
     sanitizes: Set[str] = field(default_factory=set)
     acquires: Set[str] = field(default_factory=set)
     untrusted: List[str] = field(default_factory=list)
+    responders: List[str] = field(default_factory=list)
+    loop_only: bool = False
     # Filled by the taint summary pass (taint.summarize_function);
     # JSON-serializable so the result cache can persist it.
     taint: Optional[dict] = None
+    # Filled by asyncproto.summarize_functions: responder hand-off
+    # edges for the global reply-once resolution pass.
+    asyncp: Optional[dict] = None
     node: Optional[ast.AST] = None   # not serialized
 
     def to_dict(self) -> dict:
@@ -438,7 +492,10 @@ class FunctionInfo:
                 "sanitizes": sorted(self.sanitizes),
                 "acquires": sorted(self.acquires),
                 "untrusted": list(self.untrusted),
-                "taint": self.taint}
+                "responders": list(self.responders),
+                "loop_only": self.loop_only,
+                "taint": self.taint,
+                "asyncp": self.asyncp}
 
     @classmethod
     def from_dict(cls, d: dict) -> "FunctionInfo":
@@ -448,7 +505,10 @@ class FunctionInfo:
                    sanitizes=set(d.get("sanitizes", ())),
                    acquires=set(d.get("acquires", ())),
                    untrusted=list(d.get("untrusted", ())),
-                   taint=d.get("taint"))
+                   responders=list(d.get("responders", ())),
+                   loop_only=bool(d.get("loop_only", False)),
+                   taint=d.get("taint"),
+                   asyncp=d.get("asyncp"))
 
 
 def _signature_lines(node: ast.AST) -> Set[int]:
@@ -494,6 +554,12 @@ def collect_functions(model: ModuleModel) -> List[FunctionInfo]:
                         info.untrusted.extend(
                             s for s in d.untrusted[ln]
                             if s not in info.untrusted)
+                    if ln in d.responders:
+                        info.responders.extend(
+                            s for s in d.responders[ln]
+                            if s not in info.responders)
+                    if ln in d.loop_only:
+                        info.loop_only = True
                 out.append(info)
                 visit(child, qual, cls)
             elif isinstance(child, ast.ClassDef):
@@ -521,6 +587,9 @@ class Hooks:
         pass
 
     def on_call(self, node: ast.Call, held: List[LockRef]) -> None:
+        pass
+
+    def on_await(self, node: ast.Await, held: List[LockRef]) -> None:
         pass
 
 
@@ -632,6 +701,8 @@ class HeldWalker:
             self.hooks.on_call(node, list(self.held))
         if isinstance(node, ast.Attribute):
             self.hooks.on_attr(node, list(self.held))
+        if isinstance(node, ast.Await):
+            self.hooks.on_await(node, list(self.held))
         for child in ast.iter_child_nodes(node):
             self._walk(child)
 
@@ -757,9 +828,9 @@ _DEF_NAME_RE = re.compile(r"^\s*(?:async\s+)?def\s+(\w+)")
 
 
 def scan_directives(sources: Dict[str, str]
-                    ) -> Tuple[str, Dict[str, Set[str]], Set[str]]:
+                    ) -> Tuple[str, Dict[str, Set[str]], Set[str], Set[str]]:
     """Regex pre-pass over raw sources (no parsing): returns
-    (fingerprint, sanitizer map, acquires name set).
+    (fingerprint, sanitizer map, acquires name set, loop-only name set).
 
     Per-file analysis results depend on which *names* carry sanitizes/
     acquires/untrusted annotations anywhere in the tree (the taint pass
@@ -772,6 +843,7 @@ def scan_directives(sources: Dict[str, str]
     entries: List[Tuple[str, int, str, str]] = []
     sanitizers: Dict[str, Set[str]] = {}
     acquires: Set[str] = set()
+    loop_only: Set[str] = set()
     for rel in sorted(sources):
         lines = sources[rel].splitlines()
         for i, text in enumerate(lines):
@@ -780,11 +852,14 @@ def scan_directives(sources: Dict[str, str]
             hit = None
             for regex, kind in ((_SANITIZES_RE, "sanitizes"),
                                 (_ACQUIRES_RE, "acquires"),
-                                (_UNTRUSTED_RE, "untrusted")):
+                                (_UNTRUSTED_RE, "untrusted"),
+                                (_RESPONDER_RE, "responder")):
                 m = regex.search(text)
                 if m:
                     hit = (kind, m.group(1))
                     break
+            if hit is None and _LOOP_ONLY_RE.search(text):
+                hit = ("loop-only", "")
             if hit is None:
                 continue
             # Associate with the owning def: same line; a pure-comment
@@ -813,8 +888,10 @@ def scan_directives(sources: Dict[str, str]
                 sanitizers.setdefault(defname, set()).update(tags)
             elif defname and hit[0] == "acquires":
                 acquires.add(defname)
+            elif defname and hit[0] == "loop-only":
+                loop_only.add(defname)
     fp = hashlib.sha256(repr(entries).encode()).hexdigest()
-    return fp, sanitizers, acquires
+    return fp, sanitizers, acquires, loop_only
 
 
 def analyze_paths(paths: Sequence[str],
@@ -836,8 +913,8 @@ def analyze_paths(paths: Sequence[str],
     import hashlib
     import time as _time
 
-    from . import (device_sync, jit_hygiene, lifecycle, lockrules, taint,
-                   wirecompat)
+    from . import (asyncproto, device_sync, jit_hygiene, lifecycle,
+                   lockrules, taint, wirecompat)
 
     config = config or AnalyzerConfig()
     files = _collect_py_files(paths)
@@ -863,7 +940,8 @@ def analyze_paths(paths: Sequence[str],
             by_rel[rel] = (rel, path)
         except OSError as e:
             findings.append(Finding("parse-error", rel, 1, str(e)))
-    directive_fp, sanitizer_map, acquires_names = scan_directives(sources)
+    directive_fp, sanitizer_map, acquires_names, loop_only_names = \
+        scan_directives(sources)
     cfg_fp = hashlib.sha256(
         repr(sorted(config.digest_fields().items())).encode()).hexdigest()
     global_key = hashlib.sha256(
@@ -897,6 +975,8 @@ def analyze_paths(paths: Sequence[str],
             rec.functions = collect_functions(rec.model)
             _timed("taint", taint.summarize_functions,
                    rec.model, rec.functions, sanitizer_map)
+            _timed("asyncproto", asyncproto.summarize_functions,
+                   rec.model, rec.functions)
             rec.callsites = _collect_callsites(rec.model)
             raw: List[Finding] = []
             raw.extend(_timed("lockrules", lockrules.check_module,
@@ -907,6 +987,9 @@ def analyze_paths(paths: Sequence[str],
                               rec.model, config))
             raw.extend(_timed("lifecycle", lifecycle.check_module,
                               rec.model, config, acquires_names))
+            raw.extend(_timed("asyncproto", asyncproto.check_module,
+                              rec.model, rec.functions, config,
+                              loop_only_names))
             rec.local_findings = raw
             if cache is not None:
                 cache.put(rec.content_hash, global_key, {
@@ -933,6 +1016,8 @@ def analyze_paths(paths: Sequence[str],
         sanitizer_map))
     raw_global.extend(_timed(
         "wire-compat", wirecompat.check_paths, paths, records, config))
+    raw_global.extend(_timed(
+        "asyncproto", asyncproto.check_global, all_functions, config))
 
     # -- suppression pass --------------------------------------------------
     directives_by_rel: Dict[str, Directives] = {}

@@ -117,6 +117,10 @@ def cache_server_start(args) -> None:
                              max_workers=32)
     server.add_service(service.spec())
     server.start()
+    # aio front-end serving stats incl. `double_replies`, the runtime
+    # half of the reply-once check (doc/static_analysis.md).
+    if hasattr(server, "inspect"):
+        exposed_vars.expose("yadcc/rpc_server", server.inspect)
     inspect = InspectServer(args.inspect_port, args.inspect_credential,
                             frontend=args.rpc_frontend)
     inspect.start()

@@ -255,6 +255,13 @@ def daemon_start(args) -> None:
     exposed_vars.expose("yadcc/daemon/dispatcher", dispatcher.inspect)
     exposed_vars.expose("yadcc/daemon/monitor", monitor.inspect)
     exposed_vars.expose("yadcc/daemon/cache_reader", cache_reader.inspect)
+    # Front-end serving stats: on aio these carry `double_replies` —
+    # the runtime half of the reply-once protocol check
+    # (doc/static_analysis.md "Async protocol").
+    exposed_vars.expose("yadcc/daemon/local_http", http.inspect)
+    if hasattr(servant_server, "inspect"):
+        exposed_vars.expose("yadcc/daemon/servant_rpc",
+                            servant_server.inspect)
     logger.info("daemon up: local HTTP :%d, servant RPC :%d (as %s), "
                 "inspect :%d", http.port, servant_server.port,
                 config.location, inspect.port)

@@ -195,9 +195,15 @@ class LocalHttpService:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def inspect(self) -> dict:
+        if self._aio is not None:
+            return self._aio.inspect()
+        return {"frontend": "threaded", "port": self.port}
+
     # -- aio front end (event-loop routing) ----------------------------------
 
-    def _handle_aio(self, responder) -> None:
+    # ytpu: loop-only
+    def _handle_aio(self, responder) -> None:  # ytpu: responder(responder)
         """Runs ON the loop for every request: long-polls park, quick
         routes run inline, everything that may touch disk or RPC (cache
         shim reads, task submission) goes to the bounded worker pool.
@@ -225,17 +231,27 @@ class LocalHttpService:
         self._aio.submit(self._route_post_pooled, responder, path, body)
 
     def _route_post_pooled(self, responder, path: str,
-                           body: bytes) -> None:
+                           body: bytes) -> None:  # ytpu: responder(responder)
         try:
             self._route_post(responder, path, body)
         except Exception:
             logger.exception("error handling %s", path)
-            responder._reply(500)
+            # A route that replied and then raised must not fire a
+            # second 500 into the settled stream.
+            if not responder.replied:
+                responder._reply(500)
 
-    def _acquire_quota_parked(self, responder, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _acquire_quota_parked(self, responder, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(responder)
         req = _from_json(api.local.AcquireQuotaRequest, body)
+        deadline_timer = []
 
         def on_grant(ok: bool) -> None:
+            # The continuation won: its deadline timer must die with it
+            # (async-timer-leak discipline).  The box is filled after
+            # acquire_async returns; an inline grant simply leaves the
+            # timer to fire waiter.expire as a no-op at the deadline.
+            if deadline_timer:
+                deadline_timer[0].cancel()
             if ok:
                 responder._reply(200,
                                  _to_json(api.local.AcquireQuotaResponse()))
@@ -251,16 +267,21 @@ class LocalHttpService:
             req.requestor_pid, req.lightweight_task, on_grant)
         # The deadline half of the parked continuation: a loop timer,
         # not a polling thread (same clamp as the threaded route).
-        self._aio.call_later(clamp_wait_s(req.milliseconds_to_wait),
-                             waiter.expire)
+        deadline_timer.append(self._aio.call_later(
+            clamp_wait_s(req.milliseconds_to_wait), waiter.expire))
 
-    def _wait_parked(self, responder, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _wait_parked(self, responder, task_type, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(responder)
         req = _from_json(task_type.wait_request_cls, body)
         task_id = req.task_id
+        deadline_timer = []
 
         def on_done(result) -> None:
             if responder.replied or result is None:
                 return
+            # We are going to reply: the deadline timer dies now
+            # instead of pinning this closure until the window ends.
+            if deadline_timer:
+                deadline_timer[0].cancel()
             # Response assembly (multi-chunk join of possibly-multi-MB
             # outputs) belongs on the pool, not the loop.
             self._aio.submit(self._finish_wait_pooled, responder,
@@ -279,11 +300,11 @@ class LocalHttpService:
             responder._reply(
                 404 if not self.dispatcher.is_known(task_id) else 503)
 
-        self._aio.call_later(
-            min(req.milliseconds_to_wait, 10_000) / 1000.0, on_deadline)
+        deadline_timer.append(self._aio.call_later(
+            min(req.milliseconds_to_wait, 10_000) / 1000.0, on_deadline))
 
     def _finish_wait_pooled(self, responder, task_type, task_id: int,
-                            result) -> None:
+                            result) -> None:  # ytpu: responder(responder)
         resp, out_chunks = task_type.build_wait_response(result)
         payload = multi_chunk.make_multi_chunk_payload(
             [_to_json(resp)] + list(out_chunks))
@@ -296,7 +317,7 @@ class LocalHttpService:
 
     # -- routing -------------------------------------------------------------
 
-    def _route_post(self, handler, path: str, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _route_post(self, handler, path: str, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         if path == "/local/ask_to_leave":
             handler._reply(200, _to_json(api.local.AskToLeaveResponse()))
             self.on_leave()
@@ -348,7 +369,7 @@ class LocalHttpService:
 
     # -- generic task submit/wait (one flow for every registered kind) -------
 
-    def _submit_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _submit_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         # Views: the (possibly multi-MB) attachment chunk stays a view
         # into the request body all the way to the servant RPC.
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
@@ -370,7 +391,7 @@ class LocalHttpService:
         handler._reply(200, _to_json(
             api.local.SubmitCxxTaskResponse(task_id=task_id)))
 
-    def _wait_for_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _wait_for_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         req = _from_json(task_type.wait_request_cls, body)
         result = self.dispatcher.wait_for_task(
             req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
@@ -388,7 +409,7 @@ class LocalHttpService:
 
     # -- persistent-compile-cache shim routes --------------------------------
 
-    def _jit_cache_get(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _jit_cache_get(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         req = _from_json(api.jit.JitCacheGetRequest, body)
         if self.cache_reader is None or not req.key:
             handler._reply(404)
@@ -403,7 +424,7 @@ class LocalHttpService:
                 [_to_json(api.jit.JitCacheGetResponse()), data]),
             content_type="application/octet-stream")
 
-    def _jit_cache_put(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)
+    def _jit_cache_put(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
         if not chunks or len(chunks) != 2:
             handler._reply(400, b'{"error":"expect json+value chunks"}')

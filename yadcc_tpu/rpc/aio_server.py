@@ -51,6 +51,7 @@ import time as _time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import looplag
 from ..utils.logging import get_logger
 from ..utils.stagetimer import FRONTEND_STAGES, StageTimer
 from .transport import (
@@ -176,6 +177,7 @@ class EventLoopThread:
         self._started = threading.Event()
         self._thread.start()
         self._started.wait(5.0)
+        looplag.register(self.loop, name)
 
     def _run(self) -> None:
         asyncio.set_event_loop(self.loop)
@@ -202,6 +204,46 @@ class EventLoopThread:
         self._thread.join(timeout=5.0)
         if not self.loop.is_running():
             self.loop.close()
+
+
+class LoopTimer:
+    """Thread-safe cancel handle for a ``call_later`` armed from any
+    thread.  The loop's own TimerHandle only exists after the
+    call_soon_threadsafe hop lands; ``cancel()`` before the hop
+    suppresses arming, ``cancel()`` after it cancels on the loop.
+    Either way the timer dies — a parked continuation that wins the
+    race against its deadline MUST cancel, or the deadline fires into
+    the (runtime-guarded) settled responder and the handle pins the
+    closure until the deadline elapses."""
+
+    __slots__ = ("_loops", "_lock", "_handle", "_cancelled")
+
+    def __init__(self, loops: EventLoopThread):
+        self._loops = loops
+        self._lock = threading.Lock()
+        self._handle = None
+        self._cancelled = False
+
+    # ytpu: loop-only
+    def _arm(self, delay_s: float, fn, args) -> None:
+        with self._lock:
+            if self._cancelled:
+                return
+            self._handle = self._loops.loop.call_later(
+                delay_s, fn, *args)
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled = True
+            handle, self._handle = self._handle, None
+        if handle is not None:
+            # TimerHandle.cancel is not thread-safe; hop to the loop.
+            self._loops.call_soon(handle.cancel)
+
+    @property
+    def cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
 
 
 # ---------------------------------------------------------------------------
@@ -232,7 +274,7 @@ class _RpcConnection(asyncio.Protocol):
     def connection_lost(self, exc) -> None:
         self.server._conn_closed(self)
 
-    def data_received(self, data) -> None:
+    def data_received(self, data) -> None:  # ytpu: loop-only
         timer = self.server.stage_timer
         now = _time.perf_counter()
         if self._read_started_at is None:
@@ -261,6 +303,7 @@ class _RpcConnection(asyncio.Protocol):
 
     # -- writes (loop thread only) -----------------------------------------
 
+    # ytpu: loop-only
     def send_payload(self, seq: int, payload: Payload) -> None:
         if self.transport is None or self.transport.is_closing():
             return
@@ -292,6 +335,8 @@ class AioRpcServer:
             max_workers=max_workers, thread_name_prefix="aio-rpc-worker")
         self._conns: set = set()
         self._conn_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._double_replies = 0
         host, _, port = address.rpartition(":")
         self._asyncio_server = self.loops.run_sync(
             self._start_server(host or "127.0.0.1", int(port),
@@ -341,8 +386,22 @@ class AioRpcServer:
         with self._conn_lock:
             return len(self._conns)
 
+    def _note_double_reply(self) -> None:
+        with self._stats_lock:
+            self._double_replies += 1
+
+    def inspect(self) -> Dict[str, int]:
+        """Runtime complement to the static reply-once rule: every
+        refused second reply is counted here, so a protocol defect that
+        slips past analysis still shows up in /inspect surfaces."""
+        with self._stats_lock:
+            doubles = self._double_replies
+        return {"connections": self.connection_count(),
+                "double_replies": doubles, "port": self.port}
+
     # -- dispatch (loop thread) ----------------------------------------------
 
+    # ytpu: loop-only
     def _dispatch(self, conn: _RpcConnection, seq: int, payload) -> None:
         try:
             service, method, frame = split_request_payload(payload)
@@ -367,6 +426,7 @@ class AioRpcServer:
         fut.add_done_callback(
             lambda f: self._send_result(conn, seq, f))
 
+    # ytpu: loop-only
     def _send_result(self, conn, seq, fut) -> None:
         try:
             reply = fut.result()
@@ -376,6 +436,7 @@ class AioRpcServer:
                 STATUS_TRANSPORT_FAILURE, f"dispatch error: {e!r}".encode())
         conn.send_payload(seq, reply)
 
+    # ytpu: loop-only
     def _dispatch_parked(self, conn, seq, spec: ServiceSpec, ms,
                          frame) -> None:
         """Long-poll path: the handler runs on the loop, registers its
@@ -400,6 +461,7 @@ class AioRpcServer:
         def done(resp, *, error: Optional[RpcError] = None) -> None:
             with fired_lock:
                 if fired[0]:
+                    self._note_double_reply()
                     return
                 fired[0] = True
             t1 = _time.perf_counter()
@@ -424,11 +486,14 @@ class AioRpcServer:
             done(None, error=RpcError(STATUS_TRANSPORT_FAILURE,
                                       f"handler error: {e!r}"))
 
-    def call_later(self, delay_s: float, fn, *args) -> None:
+    def call_later(self, delay_s: float, fn, *args) -> LoopTimer:
         """Schedule ``fn`` on the loop — the timer half of a parked
-        continuation (deadline replies, poll re-arms)."""
-        self.loops.call_soon(
-            lambda: self.loops.loop.call_later(delay_s, fn, *args))
+        continuation (deadline replies, poll re-arms).  Returns a
+        thread-safe handle; the continuation that beats its deadline
+        must ``cancel()`` it (async-timer-leak discipline)."""
+        timer = LoopTimer(self.loops)
+        self.loops.call_soon(timer._arm, delay_s, fn, args)
+        return timer
 
 
 # ---------------------------------------------------------------------------
@@ -829,6 +894,7 @@ class AioHttpResponder:
         happen exactly once (e.g. free_task) belongs to the winner."""
         with self._reply_lock:
             if self._replied:
+                self.server._note_double_reply()
                 return False
             self._replied = True
         head = [f"HTTP/1.1 {code} {_HTTP_STATUS_TEXT.get(code, 'X')}",
@@ -868,7 +934,7 @@ class _HttpConnection(asyncio.Protocol):
     def connection_lost(self, exc) -> None:
         self.server._conn_closed(self)
 
-    def data_received(self, data) -> None:
+    def data_received(self, data) -> None:  # ytpu: loop-only
         timer = self.server.stage_timer
         try:
             t0 = _time.perf_counter()
@@ -891,13 +957,20 @@ class _HttpConnection(asyncio.Protocol):
             self._first_seen = True
             timer.record("accept", _time.perf_counter() - self._accepted_at)
         for req in requests:
-            responder = AioHttpResponder(self.server, self, req)
-            try:
-                self.server.handler_fn(responder)
-            except Exception:
-                logger.exception("http handler failed for %s", req.path)
+            self._invoke_handler(AioHttpResponder(self.server, self, req))
+
+    # ytpu: loop-only
+    def _invoke_handler(self, responder) -> None:  # ytpu: responder(responder)
+        try:
+            self.server.handler_fn(responder)
+        except Exception:
+            logger.exception("http handler failed for %s", responder.path)
+            # A handler that already replied and THEN raised must not
+            # double-fire the 500 into the settled stream.
+            if not responder.replied:
                 responder._reply(500)
 
+    # ytpu: loop-only
     def write_segments(self, segments) -> None:
         if self.transport is None or self.transport.is_closing():
             return
@@ -932,6 +1005,8 @@ class AioHttpServer:
             max_workers=max_workers, thread_name_prefix="aio-http-worker")
         self._conns: set = set()
         self._conn_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._double_replies = 0
         host, _, port = address.rpartition(":")
         self._asyncio_server = self.loops.run_sync(
             self._start(host or "127.0.0.1", int(port)))
@@ -952,9 +1027,12 @@ class AioHttpServer:
         except Exception:
             logger.exception("http pool task failed")
 
-    def call_later(self, delay_s: float, fn, *args) -> None:
-        self.loops.call_soon(
-            lambda: self.loops.loop.call_later(delay_s, fn, *args))
+    def call_later(self, delay_s: float, fn, *args) -> LoopTimer:
+        """See AioRpcServer.call_later: returns a thread-safe cancel
+        handle so the winning continuation can kill its deadline."""
+        timer = LoopTimer(self.loops)
+        self.loops.call_soon(timer._arm, delay_s, fn, args)
+        return timer
 
     def connection_count(self) -> int:
         with self._conn_lock:
@@ -967,6 +1045,18 @@ class AioHttpServer:
     def _conn_closed(self, conn) -> None:
         with self._conn_lock:
             self._conns.discard(conn)
+
+    def _note_double_reply(self) -> None:
+        with self._stats_lock:
+            self._double_replies += 1
+
+    def inspect(self) -> Dict[str, int]:
+        """Refused second replies, for the same reason as
+        AioRpcServer.inspect: the runtime half of reply-once."""
+        with self._stats_lock:
+            doubles = self._double_replies
+        return {"connections": self.connection_count(),
+                "double_replies": doubles, "port": self.port}
 
     def start(self) -> None:
         pass

@@ -212,6 +212,10 @@ def scheduler_start(args) -> None:
     server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}")
     server.add_service(service.spec())
     server.start()
+    # aio front-end serving stats incl. `double_replies`, the runtime
+    # half of the reply-once check (doc/static_analysis.md).
+    if hasattr(server, "inspect"):
+        exposed_vars.expose("yadcc/rpc_server", server.inspect)
     inspect = InspectServer(args.inspect_port, args.inspect_credential,
                             frontend=args.rpc_frontend)
     inspect.start()

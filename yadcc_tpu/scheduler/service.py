@@ -284,7 +284,8 @@ class SchedulerService:
             resp.grants.add(task_grant_id=gid, servant_location=location)
         return resp
 
-    def WaitForStartingTaskParked(self, req, attachment, ctx, done):
+    # ytpu: loop-only
+    def WaitForStartingTaskParked(self, req, attachment, ctx, done):  # ytpu: responder(done)
         """Parked-continuation WaitForStartingTask (aio front end).
 
         Validation, admission ruling and the enqueue run inline on the

@@ -530,7 +530,7 @@ class TaskDispatcher:
         lease_s: float = 15.0,
         timeout_s: float = 5.0,
         on_done: Callable,
-    ) -> None:
+    ) -> None:  # ytpu: responder(on_done)
         """Parked-continuation twin of wait_for_starting_new_task (the
         aio front end's long-poll path, doc/scheduler.md "RPC front
         end"): enqueue the request and return immediately; ``on_done``
