@@ -484,6 +484,12 @@ def main() -> None:
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 11 (r16+): adds `failover_time_ms` (kill-to-first-
+        # granted-RPC through the warm-standby takeover in a smoke
+        # cell-kill run, tools/scenarios.py; doc/robustness.md
+        # "Failover state machine") and `cell_kill_success_rate` (fleet
+        # compile success across that kill, local fallback counted).
+        # Every v10 field is still emitted.
         # Version 10 (r15+): adds `device_resident_assignments_per_sec`
         # (the fused device-resident dispatch step at the production
         # task cap — pool donated across launches, heartbeat deltas
@@ -531,7 +537,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 10,
+        "harness_version": 11,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -580,6 +586,8 @@ def main() -> None:
         "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
         "survival_compile_success_rate": hostile.get(
             "survival_compile_success_rate"),
+        "failover_time_ms": hostile.get("failover_time_ms"),
+        "cell_kill_success_rate": hostile.get("cell_kill_success_rate"),
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

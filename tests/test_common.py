@@ -113,6 +113,33 @@ class TestConsistentHash:
         big = sum(1 for k in keys if ring.pick(k) == "big")
         assert 0.6 < big / len(keys) < 0.9
 
+    def test_zero_weight_is_typed_error(self):
+        ring = consistent_hash.ConsistentHash([])
+        with pytest.raises(consistent_hash.ZeroWeightError):
+            ring.add_node("dead-cell", 0)
+        with pytest.raises(consistent_hash.ZeroWeightError):
+            consistent_hash.ConsistentHash([("a", 1), ("b", -2)])
+        # The typed error stays catchable as the historical ValueError.
+        assert issubclass(consistent_hash.ZeroWeightError, ValueError)
+
+    def test_fully_drained_ring_is_typed_error(self):
+        """Cell drain during failover: every member removed.  Routing
+        must fail with the typed error (callers degrade cleanly), not
+        KeyError/IndexError from an empty bisect."""
+        ring = consistent_hash.ConsistentHash([("a", 1), ("b", 2)])
+        ring.remove_node("a")
+        ring.remove_node("b")
+        ring.remove_node("b")  # idempotent leave stays a no-op
+        assert len(ring) == 0
+        with pytest.raises(consistent_hash.EmptyRingError):
+            ring.pick("any-key")
+        with pytest.raises(consistent_hash.EmptyRingError):
+            consistent_hash.ConsistentHash([]).pick("k")
+        assert issubclass(consistent_hash.EmptyRingError, ValueError)
+        # Re-adding a member revives routing.
+        ring.add_node("a", 1)
+        assert ring.pick("any-key") == "a"
+
 
 class TestTokenVerifier:
     def test_empty_accepts_all(self):

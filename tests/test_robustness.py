@@ -24,8 +24,8 @@ from yadcc_tpu.rpc import (Channel, RpcError, ServiceSpec,
                            register_mock_server, unregister_mock_server)
 from yadcc_tpu.scheduler.admission import (
     FLOW_COMPILE_LOCALLY, FLOW_NONE, FLOW_REJECT, RUNG_LOCAL_ONLY,
-    RUNG_NORMAL, RUNG_REJECT, RUNG_SHED_OPTIONAL, AdmissionConfig,
-    OverloadLadder)
+    RUNG_NORMAL, RUNG_REJECT, RUNG_SHED_OPTIONAL, RUNG_SPILLOVER,
+    AdmissionConfig, OverloadLadder)
 from yadcc_tpu.scheduler.policy import GreedyCpuPolicy
 from yadcc_tpu.scheduler.service import SchedulerService
 from yadcc_tpu.scheduler.task_dispatcher import ServantInfo, TaskDispatcher
@@ -125,7 +125,7 @@ class TestTaskQuotaNoHotSpin:
 
 
 def ladder(**kw) -> OverloadLadder:
-    defaults = dict(up_thresholds=(1.2, 2.0, 3.0), down_fraction=0.6,
+    defaults = dict(up_thresholds=(1.2, 1.6, 2.0, 3.0), down_fraction=0.6,
                     up_dwell_s=0.25, down_dwell_s=1.0,
                     demand_window_s=5.0)
     defaults.update(kw)
@@ -139,9 +139,10 @@ class TestOverloadLadder:
         assert lad.update(10.0, 4, t) == RUNG_SHED_OPTIONAL
         # Within the up-dwell: no second step no matter the signal.
         assert lad.update(10.0, 4, t + 0.1) == RUNG_SHED_OPTIONAL
-        assert lad.update(10.0, 4, t + 0.3) == RUNG_LOCAL_ONLY
-        assert lad.update(10.0, 4, t + 0.6) == RUNG_REJECT
-        assert lad.update(10.0, 4, t + 0.9) == RUNG_REJECT  # ceiling
+        assert lad.update(10.0, 4, t + 0.3) == RUNG_SPILLOVER
+        assert lad.update(10.0, 4, t + 0.6) == RUNG_LOCAL_ONLY
+        assert lad.update(10.0, 4, t + 0.9) == RUNG_REJECT
+        assert lad.update(10.0, 4, t + 1.2) == RUNG_REJECT  # ceiling
 
     def test_4x_overload_reaches_reject_and_recovers_no_flapping(self):
         """The acceptance scenario: sustained 4x-capacity demand climbs
@@ -161,16 +162,16 @@ class TestOverloadLadder:
             t += 0.1
         assert lad.rung() == RUNG_NORMAL
         trans = lad.transitions()
-        assert len(trans) == 6, trans  # 3 up + 3 down, nothing else
+        assert len(trans) == 8, trans  # 4 up + 4 down, nothing else
         rungs = [b for _, _, b in trans]
-        assert rungs == [1, 2, 3, 2, 1, 0]
+        assert rungs == [1, 2, 3, 4, 3, 2, 1, 0]
 
     def test_hysteresis_band_holds_rung(self):
         """A signal between the step-down and step-up thresholds parks
         the ladder — no oscillation."""
         lad = ladder()
         assert lad.update(1.5, 4, 100.0) == RUNG_SHED_OPTIONAL
-        # 1.0 is below up[1]=2.0 and above down=up[0]*0.6=0.72.
+        # 1.0 is below up[1]=1.6 and above down=up[0]*0.6=0.72.
         for i in range(100):
             assert lad.update(1.0, 4, 101.0 + i) == RUNG_SHED_OPTIONAL
         assert len(lad.transitions()) == 1
@@ -182,10 +183,11 @@ class TestOverloadLadder:
         lad = ladder(demand_window_s=2.0)
         lad.update(10.0, 4, 100.0)
         lad.update(10.0, 4, 100.5)
+        lad.update(10.0, 4, 100.8)
         assert lad.rung() == RUNG_LOCAL_ONLY
         # Storm continues: utilization is now 0 (everything refused),
         # but 25 refused requests/second press on a capacity of 4.
-        t = 100.6
+        t = 100.9
         while t < 110.0:
             d = lad.decide(0.0, 4, immediate=1, prefetch=0, now=t)
             assert d.flow != FLOW_NONE, t  # never silently re-admitted
@@ -197,14 +199,14 @@ class TestOverloadLadder:
     def test_reject_retry_after_scales_and_clamps(self):
         lad = ladder(up_dwell_s=0.0,
                      retry_after_base_ms=100, retry_after_max_ms=1000)
-        for i in range(3):
+        for i in range(4):
             lad.update(100.0, 4, 100.0 + i)
         d = lad.decide(100.0, 4, immediate=1, prefetch=0, now=104.0)
         assert d.flow == FLOW_REJECT
         assert d.retry_after_ms == 1000  # deep overload: clamped max
         lad2 = ladder(up_dwell_s=0.0,
                       retry_after_base_ms=100, retry_after_max_ms=1000)
-        for i in range(3):
+        for i in range(4):
             lad2.update(3.1, 4, 100.0 + i)
         d2 = lad2.decide(3.0, 4, immediate=1, prefetch=0, now=104.0)
         assert d2.flow == FLOW_REJECT
@@ -241,7 +243,7 @@ def flow_rig():
         GreedyCpuPolicy(), max_servants=16, max_envs=64, clock=clock,
         batch_window_s=0.0,
         admission_config=AdmissionConfig(
-            up_thresholds=(1.5, 3.0, 6.0), up_dwell_s=0.0,
+            up_thresholds=(1.5, 2.2, 3.0, 6.0), up_dwell_s=0.0,
             down_dwell_s=1e6))
     d.keep_servant_alive(make_servant("10.0.0.1:8335"), 1000)
     sched = SchedulerService(d)

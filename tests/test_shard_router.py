@@ -183,15 +183,34 @@ class TestGrantIdNamespacing:
                            max_servants=64, grant_id_start=5,
                            grant_id_stride=4,
                            start_dispatch_thread=False)
-        d = TaskDispatcher(make_policy("greedy_cpu", max_servants=64,
-                                       avoid_self=False),
-                           max_servants=64, grant_id_start=3,
-                           grant_id_stride=4,
-                           start_dispatch_thread=False,
-                           min_memory_for_new_task=1)
+        # Stride 3 over 2 shards: not a multiple of N, so ids would
+        # alias across shards and shard_of_grant would misroute.
+        ds = [TaskDispatcher(make_policy("greedy_cpu", max_servants=64,
+                                         avoid_self=False),
+                             max_servants=64, grant_id_start=k + 1,
+                             grant_id_stride=3,
+                             start_dispatch_thread=False,
+                             min_memory_for_new_task=1)
+              for k in range(2)]
         with pytest.raises(ValueError):
-            ShardRouter([d])  # stride 4 for a 1-shard router
-        d.stop()
+            ShardRouter(ds)
+        for d in ds:
+            d.stop()
+        # A stride that is a LARGER multiple of N is the federation
+        # namespace (cell c of C cells: start = c*N + k + 1, stride =
+        # C*N) and must be accepted — ids still satisfy ≡ k+1 (mod N).
+        ds = [TaskDispatcher(make_policy("greedy_cpu", max_servants=64,
+                                         avoid_self=False),
+                             max_servants=64,
+                             grant_id_start=2 * 2 + k + 1,
+                             grant_id_stride=3 * 2,
+                             start_dispatch_thread=False,
+                             min_memory_for_new_task=1)
+              for k in range(2)]
+        router = ShardRouter(ds)
+        assert [router.shard_of_grant(d._next_grant_id)
+                for d in ds] == [0, 1]
+        router.stop()
 
 
 class TestStealing:

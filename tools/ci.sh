@@ -160,6 +160,15 @@ if ! python -m yadcc_tpu.tools.cluster_sim --scenario overload-ladder --smoke; t
   echo "chaos smoke (overload-ladder) FAILED" >&2
   fail=1
 fi
+# Federation tentpole (doc/robustness.md "Failover state machine"):
+# overload on one cell must spill to the peer BEFORE local-only
+# degradation, and killing the active scheduler mid-spike must cost
+# one renewal interval — standby takeover, zero double-issued grants,
+# every straddling lease renewable exactly once.
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario cell-kill --smoke; then
+  echo "chaos smoke (cell-kill) FAILED" >&2
+  fail=1
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

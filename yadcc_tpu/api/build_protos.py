@@ -206,7 +206,12 @@ def _scheduler_descriptor():
          # Owning (issuing) shard; `stolen` marks grants pulled through
          # the cross-shard steal channel (shard_id is then the donor).
          ("shard_id", 3, "uint32"),
-         ("stolen", 4, "bool"))
+         ("stolen", 4, "bool"),
+         # Multi-cell federation (scheduler/federation.py): the cell
+         # whose dispatcher owns this grant; `spilled` marks grants
+         # forwarded to a peer cell by the SPILLOVER admission rung.
+         ("cell_id", 5, "uint32"),
+         ("spilled", 6, "bool"))
     _msg(fd, "WaitForStartingTaskRequest",
          ("token", 1, "string"),
          ("milliseconds_to_wait", 2, "uint32"),
@@ -223,7 +228,11 @@ def _scheduler_descriptor():
          # Home shard that served the request + how many of `grants`
          # were stolen from donors on its behalf.
          ("shard_id", 5, "uint32"),
-         ("stolen_grants", 6, "uint32"))
+         ("stolen_grants", 6, "uint32"),
+         # Home cell that served the request + how many of `grants`
+         # were spilled to peer cells on its behalf.
+         ("cell_id", 7, "uint32"),
+         ("spilled_grants", 8, "uint32"))
     _msg(fd, "KeepTaskAliveRequest",
          ("token", 1, "string"),
          ("task_grant_ids", 2, "uint64", "repeated"),
@@ -237,6 +246,20 @@ def _scheduler_descriptor():
     _msg(fd, "GetRunningTasksRequest")
     _msg(fd, "GetRunningTasksResponse",
          ("running_tasks", 1, ".ytpu.api.RunningTask", "repeated"))
+    # Warm-standby replication (scheduler/replication.py): the active
+    # scheduler streams its lease journal to a standby.  Entries are a
+    # JSON-encoded batch (the journal is Python-dict-shaped and
+    # schema-fluid; the envelope, not the entries, is the wire
+    # contract).  A non-empty snapshot_json replaces the standby's
+    # whole state before the entries are applied.
+    _msg(fd, "ReplicateRequest",
+         ("token", 1, "string"),
+         ("first_seq", 2, "uint64"),
+         ("entries_json", 3, "bytes"),
+         ("snapshot_json", 4, "bytes"),
+         ("snapshot_seq", 5, "uint64"))
+    _msg(fd, "ReplicateResponse",
+         ("acked_seq", 1, "uint64"))
     _service(fd, "SchedulerService",
              ("Heartbeat", ".ytpu.api.HeartbeatRequest",
               ".ytpu.api.HeartbeatResponse"),
@@ -250,6 +273,9 @@ def _scheduler_descriptor():
               ".ytpu.api.FreeTaskResponse"),
              ("GetRunningTasks", ".ytpu.api.GetRunningTasksRequest",
               ".ytpu.api.GetRunningTasksResponse"))
+    _service(fd, "ReplicationService",
+             ("Replicate", ".ytpu.api.ReplicateRequest",
+              ".ytpu.api.ReplicateResponse"))
     return fd
 
 

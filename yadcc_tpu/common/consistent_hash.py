@@ -37,6 +37,22 @@ def _hash(data: str) -> int:
     return xxhash.xxh64_intdigest(data)
 
 
+class EmptyRingError(ValueError):
+    """Routing against a ring with no members.
+
+    A drained ring is a legitimate transient during failover — every
+    cell of a federation can be mid-takeover at once — so callers need
+    a typed error they can catch and convert into a retry/degrade
+    verdict, not a bare ValueError indistinguishable from a coding
+    bug.  Subclasses ValueError so pre-federation callers that caught
+    that keep working."""
+
+
+class ZeroWeightError(ValueError):
+    """A node was added with weight <= 0 — it would own no vnodes, so
+    membership would silently not mean what the caller thinks."""
+
+
 class ConsistentHash:
     def __init__(self, nodes: Sequence[Tuple[str, int]],
                  vnodes_per_weight: int = _VNODES_PER_WEIGHT):
@@ -57,7 +73,8 @@ class ConsistentHash:
         """Insert (or re-weight) a node.  Keys the new vnodes now own
         move here; every other key keeps its mapping."""
         if weight <= 0:
-            raise ValueError(f"weight must be positive: {name}={weight}")
+            raise ZeroWeightError(
+                f"weight must be positive: {name}={weight}")
         if name in self._weights:
             if self._weights[name] == weight:
                 return
@@ -106,7 +123,9 @@ class ConsistentHash:
 
     def pick(self, key: str) -> str:
         if not self._points:
-            raise ValueError("empty ring")
+            raise EmptyRingError(
+                "empty ring: no nodes with positive weight "
+                "(membership fully drained)")
         idx = bisect.bisect_right(self._points, _hash(key))
         if idx == len(self._points):
             idx = 0

@@ -51,7 +51,17 @@ _SCRUBBED_ENV = ("LC_ALL", "GCC_COMPARE_DEBUG", "SOURCE_DATE_EPOCH")
 
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("yadcc-tpu-daemon")
-    p.add_argument("--scheduler-uri", default="grpc://127.0.0.1:8336")
+    p.add_argument("--scheduler-uri", default="grpc://127.0.0.1:8336",
+                   help="scheduler endpoint(s).  Comma-separated URIs "
+                        "are an ordered active,standby failover list "
+                        "(dialed through FailoverChannel: on "
+                        "transport failure / NOT_SERVING the daemon "
+                        "rotates and re-dials under backoff); "
+                        "';'-separated groups are federation CELLS, "
+                        "each group its own failover list — a "
+                        "compiler env's home cell is picked by "
+                        "consistent hash on its digest "
+                        "(doc/scheduler.md \"Federation\")")
     p.add_argument("--cache-server-uri", default="")
     p.add_argument("--token", default="")
     p.add_argument("--local-port", type=int, default=8334)
@@ -117,7 +127,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def _guess_local_ip(scheduler_uri: str) -> str:
-    target = scheduler_uri.split("://")[-1]
+    # Multi-URI forms (cell groups ';', failover lists ','): route
+    # discovery only needs ONE reachable peer — use the first URI.
+    first = scheduler_uri.split(";")[0].split(",")[0].strip()
+    target = first.split("://")[-1]
     host, _, port = target.rpartition(":")
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -149,8 +162,14 @@ def daemon_start(args) -> None:
     if not args.no_privilege_drop:
         drop_privileges()
 
+    # Federation: a servant BELONGS to one cell — heartbeats, config
+    # pulls, and running-task renewal dial only the first ';'-group
+    # (its own cell's active,standby failover list).  Only the grant
+    # keeper (delegate role) federates across all cells, homing each
+    # compiler env by digest.
+    cell_uri = args.scheduler_uri.split(";")[0].strip()
     config = DaemonConfig(
-        scheduler_uri=args.scheduler_uri,
+        scheduler_uri=cell_uri,
         cache_server_uri=args.cache_server_uri,
         token=args.token,
         serving_port=args.serving_port,
@@ -187,7 +206,7 @@ def daemon_start(args) -> None:
                                      f"0.0.0.0:{args.serving_port}")
     config.location = args.location or \
         f"{_guess_local_ip(args.scheduler_uri)}:{servant_server.port}"
-    config_keeper = ConfigKeeper(args.scheduler_uri, args.token)
+    config_keeper = ConfigKeeper(cell_uri, args.token)
     # PutEntry authenticates with the daemon's STATIC token (the cache
     # server checks --acceptable-servant-tokens; reference
     # distributed_cache_writer.cc:68 sends FLAGS_token) — NOT the
@@ -213,7 +232,7 @@ def daemon_start(args) -> None:
     # ---- delegate role ----
     grant_keeper = TaskGrantKeeper(args.scheduler_uri, args.token)
     cache_reader = DistributedCacheReader(args.cache_server_uri, args.token)
-    running_keeper = RunningTaskKeeper(args.scheduler_uri)
+    running_keeper = RunningTaskKeeper(cell_uri)
     dispatcher = DistributedTaskDispatcher(
         grant_keeper=grant_keeper,
         config_keeper=config_keeper,

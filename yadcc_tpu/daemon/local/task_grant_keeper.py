@@ -18,6 +18,8 @@ from typing import Dict, Optional, Tuple
 
 from ... import api
 from ...common.backoff import Backoff
+from ...common.consistent_hash import (SCHEDULER_VNODES_PER_WEIGHT,
+                                       ConsistentHash)
 from ...rpc import Channel, RpcError
 from ...utils.logging import get_logger
 from .fair_admission import FairGrantQueue
@@ -177,13 +179,28 @@ class TaskGrantKeeper:
 
     def __init__(self, scheduler_uri: str, token: str,
                  min_version: int = 0):
-        self._uri = scheduler_uri
+        # Multi-cell federation (doc/scheduler.md "Federation"):
+        # ``scheduler_uri`` is ";"-separated cell groups, each group a
+        # comma-separated active,standby failover list (the comma form
+        # dials through rpc.FailoverChannel).  A compiler env's home
+        # cell is picked by consistent hash on its digest — the same
+        # ring discipline the cells use — so this delegate's fetches
+        # land where that toolchain's artifacts are warm.  The common
+        # single-cell "host:port" form takes the exact old path.
+        self._cell_uris = [u.strip() for u in scheduler_uri.split(";")
+                           if u.strip()]
+        if not self._cell_uris:
+            raise ValueError("scheduler_uri must name at least one cell")
+        self._ring = (ConsistentHash(
+            [(str(i), 1) for i in range(len(self._cell_uris))],
+            vnodes_per_weight=SCHEDULER_VNODES_PER_WEIGHT)
+            if len(self._cell_uris) > 1 else None)
         self._token = token
         self._min_version = min_version
         self._lock = threading.Lock()
         self._fetchers: Dict[str, _EnvFetcher] = {}  # guarded by: self._lock
         self._stopping = threading.Event()
-        self._channel: Optional[Channel] = None  # guarded by: self._lock
+        self._channels: Dict[int, Channel] = {}  # guarded by: self._lock
         # Last scheduler flow-control verdict and when it stops being
         # authoritative: (FlowControlVerdict value, monotonic deadline).
         self._flow: Tuple[int, float] = (0, 0.0)  # guarded by: self._lock
@@ -278,11 +295,18 @@ class TaskGrantKeeper:
 
     # -- internals -----------------------------------------------------------
 
-    def _chan(self) -> Channel:
+    def _chan(self, env_digest: str = "") -> Channel:
+        """Channel to the env's home cell (empty digest = cell 0).
+        Renew/free carry only grant ids, not digests; they go to cell 0
+        and the federation router routes them home by the grant-id
+        namespace — any cell can accept them."""
+        cell = (int(self._ring.pick(env_digest))
+                if self._ring is not None and env_digest else 0)
         with self._lock:
-            if self._channel is None:
-                self._channel = Channel(self._uri)
-            return self._channel
+            ch = self._channels.get(cell)
+            if ch is None:
+                ch = self._channels[cell] = Channel(self._cell_uris[cell])
+            return ch
 
     def _fetch(self, env_digest: str, immediate: int, prefetch: int):
         """One grant poll.  Returns (grants, flow_verdict,
@@ -299,7 +323,7 @@ class TaskGrantKeeper:
         )
         req.env_desc.compiler_digest = env_digest
         try:
-            resp, _ = self._chan().call(
+            resp, _ = self._chan(env_digest).call(
                 "ytpu.SchedulerService", "WaitForStartingTask", req,
                 api.scheduler.WaitForStartingTaskResponse,
                 timeout=_POLL_LAP_MS / 1000.0 + _RPC_TIMEOUT_MARGIN_S)

@@ -19,18 +19,21 @@ table; the same object can be mounted on either transport.
 
 from .transport import (
     Channel,
+    FailoverChannel,
     RpcContext,
     RpcError,
     ServiceSpec,
     install_fault_injector,
     method,
     register_mock_server,
+    retry_after_ms_from_error,
     unregister_mock_server,
 )
 from .grpc_transport import GrpcServer
 
 __all__ = [
     "Channel",
+    "FailoverChannel",
     "GrpcServer",
     "RpcContext",
     "RpcError",
@@ -38,6 +41,7 @@ __all__ = [
     "install_fault_injector",
     "method",
     "register_mock_server",
+    "retry_after_ms_from_error",
     "unregister_mock_server",
 ]
 

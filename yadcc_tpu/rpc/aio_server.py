@@ -658,6 +658,7 @@ class AioChannel(Channel):
                  timeout: Optional[float] = None) -> bytes:
         """Send a pre-encoded request frame, return the raw reply frame
         (byte-parity harness; production uses call())."""
+        apply_faults(self._target, service, method_name)
         sock = self._ensure_sock()
         event = threading.Event()
         waiter = [event, None]
@@ -693,6 +694,7 @@ class AsyncAioChannel:
     def __init__(self, target: str):
         target = target[len("aio://"):] if target.startswith("aio://") \
             else target
+        self._target = target
         host, _, port = target.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._transport = None
@@ -728,6 +730,10 @@ class AsyncAioChannel:
 
     async def call(self, service, method_name, request, response_cls,
                    attachment=b"", timeout: Optional[float] = None):
+        # Same chaos seam as every sync channel (tools/scenarios.py).
+        # An injector that sleeps stalls the loop — scenario injectors
+        # targeting the aio path raise or use sub-ms delays.
+        apply_faults(self._target, service, method_name)
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
         async with self._conn_lock:  # concurrent callers dial once

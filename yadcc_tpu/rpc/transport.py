@@ -41,6 +41,30 @@ class RpcError(Exception):
 STATUS_TRANSPORT_FAILURE = 1
 STATUS_METHOD_NOT_FOUND = 2
 STATUS_TIMEOUT = 3
+# A live endpoint that is deliberately not serving yet — a warm standby
+# awaiting takeover (scheduler/replication.py).  The wire's 503: the
+# error message carries a machine-readable "retry-after-ms=N" hint
+# (parse with retry_after_ms_from_error).  FailoverChannel treats it
+# like a dead peer and rotates to the next URI.
+STATUS_NOT_SERVING = 4
+
+
+def retry_after_ms_from_error(err: "RpcError",
+                              default_ms: int = 250) -> int:
+    """Extract the "retry-after-ms=N" hint a NOT_SERVING standby embeds
+    in its error message.  Error frames carry only (status, message),
+    so the hint travels in-band."""
+    marker = "retry-after-ms="
+    msg = err.message or ""
+    at = msg.find(marker)
+    if at < 0:
+        return default_ms
+    digits = []
+    for ch in msg[at + len(marker):]:
+        if not ch.isdigit():
+            break
+        digits.append(ch)
+    return int("".join(digits)) if digits else default_ms
 
 
 @dataclass
@@ -266,6 +290,11 @@ class Channel:
     (the event-loop front end's raw-TCP frame transport) or
     ``Channel("mock://scheduler")``.  A bare "host:port" is treated as
     grpc.
+
+    A comma-separated URI list ("grpc://a:8336,grpc://b:8336") builds a
+    FailoverChannel over the members in order of preference — how
+    daemons dial an active scheduler with a warm standby behind it
+    (doc/robustness.md, "Warm-standby failover").
     """
 
     def __new__(cls, uri: str):
@@ -273,6 +302,8 @@ class Channel:
             return super().__new__(cls)
         # Return the concrete subclass instance; Python's call protocol
         # then runs its __init__ exactly once (do NOT call it here).
+        if "," in uri:
+            return object.__new__(FailoverChannel)
         if uri.startswith("mock://"):
             return object.__new__(_MockChannel)
         if uri.startswith("aio://"):
@@ -296,6 +327,101 @@ class Channel:
 
     def close(self) -> None:
         pass
+
+
+class FailoverChannel(Channel):
+    """A channel over an ordered URI list ("active,standby,...").
+
+    Calls go to the currently-preferred member; on a transport-shaped
+    failure (TRANSPORT_FAILURE, TIMEOUT, NOT_SERVING) the channel
+    rotates to the next URI under common/backoff.py pacing and retries,
+    up to two laps around the list before surfacing the last error.
+    Application-status errors (NO_QUOTA, refusals, ...) pass straight
+    through — a different scheduler would answer them the same way.
+
+    Member channels are built lazily and cached, so a standby that was
+    never needed is never dialed.  Fault injection stays per-member:
+    each underlying channel applies the process-wide injector against
+    its own target, exactly as a directly-dialed channel would."""
+
+    # Failures that mean "this endpoint can't serve me right now",
+    # as opposed to "my request was ruled on".
+    _ROTATE_STATUSES = frozenset(
+        (STATUS_TRANSPORT_FAILURE, STATUS_TIMEOUT, STATUS_NOT_SERVING))
+
+    def __init__(self, uri: str):
+        self._uris = tuple(u.strip() for u in uri.split(",") if u.strip())
+        if len(self._uris) < 2:
+            raise ValueError(f"failover channel needs >= 2 URIs: {uri!r}")
+        self._lock = threading.Lock()
+        self._chans: Dict[int, Channel] = {}  # guarded by: self._lock
+        self._preferred = 0  # guarded by: self._lock
+        self._failovers = 0  # guarded by: self._lock
+
+    def _member(self, idx: int) -> Channel:
+        with self._lock:
+            ch = self._chans.get(idx)
+            if ch is None:
+                ch = Channel(self._uris[idx])
+                self._chans[idx] = ch
+            return ch
+
+    def call(self, service, method_name, request, response_cls,
+             attachment=b"", timeout=None):
+        from ..common.backoff import Backoff
+
+        with self._lock:
+            start = self._preferred
+        backoff = Backoff(initial_s=0.02, max_s=0.5)
+        last: Optional[RpcError] = None
+        for attempt in range(2 * len(self._uris)):
+            idx = (start + attempt) % len(self._uris)
+            try:
+                result = self._member(idx).call(
+                    service, method_name, request, response_cls,
+                    attachment, timeout)
+            except RpcError as e:
+                if e.status not in self._ROTATE_STATUSES:
+                    raise
+                last = e
+                retry_after_s = None
+                if e.status == STATUS_NOT_SERVING:
+                    retry_after_s = retry_after_ms_from_error(e) / 1000.0
+                # Drop the dead member's channel so the next attempt
+                # re-dials instead of reusing a wedged connection.
+                with self._lock:
+                    stale = self._chans.pop(idx, None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except Exception:
+                        pass
+                backoff.wait(retry_after_s)
+                continue
+            with self._lock:
+                if self._preferred != idx:
+                    self._failovers += 1
+                    self._preferred = idx
+            return result
+        assert last is not None
+        raise last
+
+    def preferred_uri(self) -> str:
+        with self._lock:
+            return self._uris[self._preferred]
+
+    def failovers(self) -> int:
+        with self._lock:
+            return self._failovers
+
+    def close(self) -> None:
+        with self._lock:
+            chans, self._chans = list(self._chans.values()), {}
+        for ch in chans:
+            try:
+                ch.close()
+            except Exception:
+                pass
 
 
 class _MockChannel(Channel):

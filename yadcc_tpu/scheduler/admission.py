@@ -11,6 +11,12 @@ request queues.
     NORMAL        grants flow, prefetch honored
     SHED_OPTIONAL prefetch (opportunistic, low-priority) is dropped;
                   immediate demand still grants
+    SPILLOVER     immediate demand still grants, but the cell is
+                  overloaded enough that a federated deployment
+                  (scheduler/federation.py) forwards grant requests to
+                  the least-loaded peer cell before anyone is told to
+                  compile locally; a single-cell scheduler treats this
+                  rung exactly like SHED_OPTIONAL
     LOCAL_ONLY    grant requests are answered immediately with an
                   explicit compile-locally verdict — the client's CPU
                   is the capacity the cluster no longer has
@@ -54,9 +60,11 @@ from typing import Deque, List, Optional, Tuple
 # WaitForStartingTaskResponse.degradation_rung.
 RUNG_NORMAL = 0
 RUNG_SHED_OPTIONAL = 1
-RUNG_LOCAL_ONLY = 2
-RUNG_REJECT = 3
-RUNG_NAMES = ("NORMAL", "SHED_OPTIONAL", "LOCAL_ONLY", "REJECT")
+RUNG_SPILLOVER = 2
+RUNG_LOCAL_ONLY = 3
+RUNG_REJECT = 4
+RUNG_NAMES = ("NORMAL", "SHED_OPTIONAL", "SPILLOVER", "LOCAL_ONLY",
+              "REJECT")
 
 # Flow-control verdicts, mirroring api.scheduler.FlowControlVerdict
 # (kept as plain ints so this module never imports protobuf).
@@ -69,12 +77,14 @@ FLOW_REJECT = 2
 class AdmissionConfig:
     """Ladder tuning.  Defaults are production-shaped: a pool running
     flat-out but draining (signal ~1) never sheds; sustained demand
-    beyond ~1.5x capacity starts dropping prefetch, ~3x pushes clients
-    to their local CPUs, ~6x refuses outright."""
+    beyond ~1.5x capacity starts dropping prefetch, ~2.2x marks the
+    cell spillover-eligible (federated deployments forward to a peer
+    cell), ~3x pushes clients to their local CPUs, ~6x refuses
+    outright."""
 
     # Step-up thresholds indexed by CURRENT rung: leaving rung r upward
     # requires signal >= up_thresholds[r].
-    up_thresholds: Tuple[float, float, float] = (1.5, 3.0, 6.0)
+    up_thresholds: Tuple[float, float, float, float] = (1.5, 2.2, 3.0, 6.0)
     # Step down from rung r when signal <= up_thresholds[r-1] * this.
     down_fraction: float = 0.6
     # Minimum dwell on a rung before stepping up / down.  Up is fast
@@ -116,6 +126,7 @@ class OverloadLadder:
         self._stats = {
             "admitted": 0,
             "prefetch_shed": 0,
+            "spillover_eligible": 0,
             "local_only_verdicts": 0,
             "rejected": 0,
         }  # guarded by: self._lock
@@ -150,6 +161,10 @@ class OverloadLadder:
                     rung=rung, flow=FLOW_COMPILE_LOCALLY,
                     prefetch_allowed=False, signal=self._signal)
             self._stats["admitted"] += 1
+            if rung >= RUNG_SPILLOVER:
+                # Still admitted here; a FederationRouter in front of
+                # this cell forwards the demand to a peer instead.
+                self._stats["spillover_eligible"] += 1
             shed_prefetch = rung >= RUNG_SHED_OPTIONAL and prefetch > 0
             if shed_prefetch:
                 self._stats["prefetch_shed"] += 1
@@ -164,6 +179,17 @@ class OverloadLadder:
         with self._lock:
             self._advance_locked(utilization, capacity, now)
             return self._rung
+
+    def restore_rung(self, rung: int, now: float) -> None:
+        """Warm-standby takeover (scheduler/replication.py): seed the
+        ladder with the rung the dead active last journaled, so the new
+        scheduler does not greet a mid-storm fleet from NORMAL.  The
+        dwell clock restarts — recovery is proven from takeover, not
+        inherited."""
+        rung = max(RUNG_NORMAL, min(int(rung), RUNG_REJECT))
+        with self._lock:
+            if rung != self._rung:
+                self._step_locked(rung, now)
 
     # -- read side -----------------------------------------------------------
 

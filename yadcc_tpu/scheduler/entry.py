@@ -74,6 +74,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "backend where device->host syncs are the cycle "
                         "bottleneck, 0 (synchronous) on host platforms; "
                         "an integer forces a depth")
+    p.add_argument("--replicate-to", default="",
+                   help="warm-standby replication (doc/robustness.md "
+                        "\"Warm-standby failover\"): stream the lease "
+                        "journal to this standby URI; on our death the "
+                        "standby replays it and takes over within one "
+                        "keep-alive interval")
+    p.add_argument("--standby", action="store_true",
+                   help="boot as the warm standby: refuse scheduler "
+                        "RPCs fast (REJECT verdict / NOT_SERVING + "
+                        "retry-after), apply the active's journal "
+                        "stream, and take over when it falls silent; "
+                        "the dispatch policy is warmed at BOOT so "
+                        "takeover replays into a ready dispatcher")
+    p.add_argument("--standby-takeover-silence", type=float, default=1.0,
+                   help="seconds of journal-stream silence before the "
+                        "standby declares the active dead")
+    p.add_argument("--replication-token", default="",
+                   help="shared secret on the journal stream (empty = "
+                        "unauthenticated, test rigs only)")
     return p
 
 
@@ -126,12 +145,13 @@ def sharded_registry_size(max_servants: int, n_shards: int) -> int:
     return max(256, (base * 10 // 8 + 64 + 255) // 256 * 256)
 
 
-def scheduler_start(args) -> None:
+def build_dispatcher(args):
+    """Policy selection + warmup + dispatcher construction, shared by
+    the active path and the standby's boot-time pre-build (the "warm"
+    in warm-standby: takeover replays into an already-warmed
+    dispatcher instead of paying policy compiles on the critical
+    path)."""
     from ..common.parse_size import parse_size
-    from ..utils.locktrace import install_from_env
-
-    install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
-    ensure_policy_backend(args.dispatch_policy)
 
     if args.shards > 1:
         # Sharded control plane (doc/scheduler.md): N PR-2 dispatchers
@@ -183,7 +203,11 @@ def scheduler_start(args) -> None:
                 args.servant_min_memory_for_new_task),
             pipeline_depth=depth,
         )
-    service = SchedulerService(
+    return dispatcher
+
+
+def build_service(dispatcher, args) -> SchedulerService:
+    return SchedulerService(
         dispatcher,
         user_tokens=make_token_verifier_from_flag(
             args.acceptable_user_tokens),
@@ -192,6 +216,79 @@ def scheduler_start(args) -> None:
         min_daemon_version=args.min_daemon_version,
         token_rotation_s=args.token_rollout_interval,
     )
+
+
+def scheduler_standby_start(args) -> None:
+    """Warm-standby role (doc/robustness.md "Failover state machine"):
+    mount the replication receiver + the refusing gate, pre-build the
+    dispatcher, and promote when the journal stream falls silent."""
+    from ..utils.locktrace import install_from_env
+    from .replication import StandbyMonitor, StandbyScheduler
+
+    install_from_env()
+    ensure_policy_backend(args.dispatch_policy)
+    dispatcher = build_dispatcher(args)  # warmed NOW, replayed at takeover
+
+    standby = StandbyScheduler(token=args.replication_token)
+    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}")
+    server.add_service(standby.receiver.spec())
+    server.add_service(standby.gate.spec())
+    server.start()
+
+    promoted = threading.Event()
+
+    def on_dead():
+        report = standby.takeover(
+            lambda: dispatcher,
+            service_factory=lambda d: build_service(d, args))
+        exposed_vars.expose("yadcc/task_dispatcher", dispatcher.inspect)
+        logger.info("promoted to active: %s", report)
+        promoted.set()
+
+    monitor = StandbyMonitor(standby.receiver, on_dead,
+                             silence_s=args.standby_takeover_silence)
+    monitor.start()
+    logger.info("standby on :%d (takeover after %.1fs stream silence)",
+                args.port, args.standby_takeover_silence)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    while not stop.is_set():
+        time.sleep(1.0)
+        if promoted.is_set():
+            dispatcher.on_expiration_timer()
+    logger.info("shutting down")
+    monitor.stop()
+    server.stop()
+    dispatcher.stop()
+
+
+def scheduler_start(args) -> None:
+    from ..utils.locktrace import install_from_env
+
+    if args.standby:
+        scheduler_standby_start(args)
+        return
+
+    install_from_env()  # YTPU_LOCKTRACE=1: lock-order checking tier
+    ensure_policy_backend(args.dispatch_policy)
+    dispatcher = build_dispatcher(args)
+    streamer = None
+    if args.replicate_to:
+        # Warm-standby replication: wrap the dispatcher so every lease
+        # mutation lands in the journal at the call boundary, and ship
+        # it (scheduler/replication.py).
+        from .replication import (JournalStreamer, LeaseJournal,
+                                  ReplicatingDispatcher)
+
+        journal = LeaseJournal()
+        dispatcher = ReplicatingDispatcher(dispatcher, journal)
+        streamer = JournalStreamer(journal, args.replicate_to,
+                                   token=args.replication_token)
+        streamer.start()
+        logger.info("replicating lease journal to %s", args.replicate_to)
+    service = build_service(dispatcher, args)
     exposed_vars.expose("yadcc/task_dispatcher", dispatcher.inspect)
     # RPC-side grant-path stages (<Method>:handler / <Method>:serialize);
     # the dispatcher's queue-wait -> apply stages ride its inspect()
@@ -234,6 +331,8 @@ def scheduler_start(args) -> None:
         gc_guard.maintain()
     logger.info("shutting down")
     gc_guard.stop()
+    if streamer is not None:
+        streamer.stop()
     server.stop()
     inspect.stop()
     dispatcher.stop()

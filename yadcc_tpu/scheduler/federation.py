@@ -1,0 +1,344 @@
+"""Multi-cell federation: N scheduler cells, one fleet.
+
+A *cell* is a full control plane — a TaskDispatcher or a sharded
+ShardRouter with its own servant registry, admission ladder, and
+(optionally) a warm standby (scheduler/replication.py).  Cells are
+routed *cell-ward* by consistent hash on the environment digest — the
+cache-key prefix — so a given toolchain's compilations concentrate
+where its artifacts are warm (doc/scheduler.md "Federation").
+
+Two cross-cell mechanisms, both deliberately narrow:
+
+* **Spillover** (the admission rung between SHED_OPTIONAL and
+  LOCAL_ONLY; scheduler/admission.py): when the home cell's ladder has
+  climbed to RUNG_SPILLOVER, new grant requests are forwarded to the
+  least-loaded peer cell that still has headroom — remote capacity
+  beats telling the delegate to burn its local CPU.  Grants carry cell
+  provenance (``cell_id`` / ``spilled`` on the wire) and stay
+  *cell-namespaced*: renewals and frees route home by grant-id
+  arithmetic alone, no table.
+* **Takeover swap**: a cell's dispatcher is reached through its
+  :class:`CellHandle`; a standby promotion swaps the handle's
+  dispatcher in place and every peer's spillover path follows without
+  re-configuration.
+
+Grant-id namespace: cell ``c`` of ``C`` cells running ``n`` shards
+issues ids with ``start = c*n + k + 1`` and ``stride = C*n`` (shard
+``k``).  Within a cell the shard residue is untouched —
+``ShardRouter.shard_of_grant`` still works — and across cells
+``cell_of_grant`` recovers the owner, so the two-level namespace costs
+one modulo.  Grant ids stay globally unique across a takeover, which
+is what makes the cell-kill double-run check meaningful.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common.consistent_hash import (SCHEDULER_VNODES_PER_WEIGHT,
+                                      ConsistentHash)
+from ..utils.clock import REAL_CLOCK, Clock
+from ..utils.logging import get_logger
+from .admission import RUNG_SPILLOVER, AdmissionDecision
+from .shard_router import RoutedGrant, RoutedGrants
+
+logger = get_logger("scheduler.federation")
+
+
+def cell_of_grant(grant_id: int, n_cells: int,
+                  shards_per_cell: int = 1) -> int:
+    """Owning cell of a grant id under the two-level namespace."""
+    return ((grant_id - 1) % (n_cells * shards_per_cell)) // shards_per_cell
+
+
+def grant_namespace_for_cell(cell: int, n_cells: int,
+                             shards_per_cell: int = 1
+                             ) -> Tuple[int, int]:
+    """(grant_id_start, grant_id_stride) for a SINGLE-dispatcher cell
+    (shard 0); sharded cells pass ``grant_namespace=(cell, n_cells)``
+    to ShardRouter.build, which applies the same arithmetic per
+    shard."""
+    return cell * shards_per_cell + 1, n_cells * shards_per_cell
+
+
+@dataclass
+class CellHandle:
+    """One cell as its peers see it.  ``dispatcher`` is read at call
+    time, never cached — a warm-standby takeover swaps it in place and
+    spillover from peer cells follows to the promoted scheduler."""
+
+    cell_id: int
+    dispatcher: object
+    uris: List[str] = field(default_factory=list)  # dialing order: active,standby
+
+
+class CellDirectory:
+    """Client-side cell pick: env digest -> home cell, by consistent
+    hash (same ring discipline the shard router uses server-side, so a
+    digest's home is stable under cell membership changes)."""
+
+    def __init__(self, cell_uris: Sequence[str], *,
+                 vnodes_per_weight: int = SCHEDULER_VNODES_PER_WEIGHT):
+        if not cell_uris:
+            raise ValueError("CellDirectory needs at least one cell URI")
+        self._uris = list(cell_uris)
+        self._ring = ConsistentHash(
+            [(str(i), 1) for i in range(len(self._uris))],
+            vnodes_per_weight=vnodes_per_weight)
+
+    def __len__(self) -> int:
+        return len(self._uris)
+
+    def home_cell(self, env_digest: str) -> int:
+        return int(self._ring.pick(env_digest))
+
+    def uri(self, cell: int) -> str:
+        """The cell's dialing URI — possibly a comma-separated
+        active,standby list (rpc.FailoverChannel)."""
+        return self._uris[cell]
+
+
+class FederationRouter:
+    """One cell's view of the federated plane.
+
+    Drop-in where a TaskDispatcher/ShardRouter was (SchedulerService
+    feature-detects with hasattr): local-plane operations — heartbeats,
+    registry, sweeps — always hit the *local* cell; the grant path adds
+    the spillover rung, and renew/free route by ``cell_of_grant`` so a
+    spilled grant's lease lives exactly one place, its issuing cell.
+
+    The parked-continuation API (``submit_wait_for_starting_new_task``)
+    is deliberately NOT exposed: parking happens inside one dispatcher
+    and cannot span cells, so the aio front end falls back to the
+    worker-pool path here — same trade the sharded router makes.
+    """
+
+    def __init__(self, cells: Sequence[CellHandle], my_cell: int, *,
+                 shards_per_cell: int = 1,
+                 spill_max_batch: int = 8,
+                 clock: Clock = REAL_CLOCK):
+        if not cells:
+            raise ValueError("federation needs at least one cell")
+        if not 0 <= my_cell < len(cells):
+            raise ValueError(f"my_cell {my_cell} out of range")
+        self._cells = list(cells)
+        self._my_cell = my_cell
+        self._n_shards = max(1, shards_per_cell)
+        self._spill_max_batch = spill_max_batch
+        self._clock = clock
+        self._lock = threading.Lock()  # leaf: counters only
+        self._stats = {"spilled_requests": 0, "spilled_grants": 0,
+                       "spill_no_peer": 0,
+                       "foreign_renewals": 0,
+                       "foreign_frees": 0}  # guarded by: self._lock
+
+    # -- plumbing ------------------------------------------------------------
+
+    @property
+    def cell_id(self) -> int:
+        return self._my_cell
+
+    @property
+    def n_cells(self) -> int:
+        return len(self._cells)
+
+    def _local(self):
+        return self._cells[self._my_cell].dispatcher
+
+    def __getattr__(self, name):
+        # Local-plane passthrough (keep_servant_alive, notify_*,
+        # get_running_tasks, adopt_grants, admission_rung, inspect,
+        # ...).  The parked submit API must stay invisible — see class
+        # docstring — so the hasattr probe in SchedulerService.spec()
+        # answers False even when the local dispatcher has it.
+        if name == "submit_wait_for_starting_new_task":
+            raise AttributeError(name)
+        return getattr(self._cells[self._my_cell].dispatcher, name)
+
+    def cell_of(self, grant_id: int) -> int:
+        return cell_of_grant(grant_id, len(self._cells), self._n_shards)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    # -- admission / home resolution ----------------------------------------
+
+    def resolve_home(self, requestor: str, env_digest: str = "") -> int:
+        """Home SHARD within the local cell (cell-level homing happened
+        client-side via CellDirectory; requests that reach this cell
+        are already cell-homed — or deliberately spilled here)."""
+        local = self._local()
+        inner = getattr(local, "resolve_home", None)
+        if inner is None:
+            return 0
+        return inner(requestor, env_digest)
+
+    def admission_check(self, immediate: int = 1, prefetch: int = 0,
+                        requestor: str = "",
+                        home: Optional[int] = None) -> AdmissionDecision:
+        local = self._local()
+        if getattr(local, "resolve_home", None) is not None:
+            return local.admission_check(immediate, prefetch, requestor,
+                                         home=home)
+        return local.admission_check(immediate, prefetch, requestor)
+
+    # -- the grant path ------------------------------------------------------
+
+    def wait_for_starting_new_task(self, env_digest: str, *,
+                                   min_version: int = 0,
+                                   requestor: str = "",
+                                   immediate: int = 1,
+                                   prefetch: int = 0,
+                                   lease_s: float = 15.0,
+                                   timeout_s: float = 5.0,
+                                   ) -> List[Tuple[int, str]]:
+        return self.wait_for_starting_new_task_routed(
+            env_digest, min_version=min_version, requestor=requestor,
+            immediate=immediate, prefetch=prefetch, lease_s=lease_s,
+            timeout_s=timeout_s).pairs()
+
+    def wait_for_starting_new_task_routed(self, env_digest: str, *,
+                                          min_version: int = 0,
+                                          requestor: str = "",
+                                          immediate: int = 1,
+                                          prefetch: int = 0,
+                                          lease_s: float = 15.0,
+                                          timeout_s: float = 5.0,
+                                          home: Optional[int] = None,
+                                          ) -> RoutedGrants:
+        """Local allocation, with the SPILLOVER rung in front: an
+        overloaded home cell forwards the immediate demand to the
+        least-loaded peer with headroom BEFORE degrading to LOCAL_ONLY
+        (admission ruled FLOW_NONE at the spillover rung precisely so
+        this path gets the request).  Prefetch never spills — it is
+        opportunistic load the fleet can drop, not forward."""
+        local = self._local()
+        if (len(self._cells) > 1
+                and local.admission_rung() >= RUNG_SPILLOVER):
+            peer = self._pick_spill_peer()
+            if peer is not None:
+                got = self._spill_to(peer, env_digest, min_version,
+                                     requestor, immediate, lease_s,
+                                     timeout_s)
+                if got.grants:
+                    return got
+                # Peer came up dry (its headroom evaporated): fall
+                # through to the local path rather than failing the
+                # request outright.
+            else:
+                self._bump("spill_no_peer")
+        routed_fn = getattr(local, "wait_for_starting_new_task_routed",
+                            None)
+        if routed_fn is not None:
+            out = routed_fn(env_digest, min_version=min_version,
+                            requestor=requestor, immediate=immediate,
+                            prefetch=prefetch, lease_s=lease_s,
+                            timeout_s=timeout_s, home=home)
+        else:
+            out = RoutedGrants(shard_id=0)
+            for gid, loc in local.wait_for_starting_new_task(
+                    env_digest, min_version=min_version,
+                    requestor=requestor, immediate=immediate,
+                    prefetch=prefetch, lease_s=lease_s,
+                    timeout_s=timeout_s):
+                out.grants.append(RoutedGrant(gid, loc, 0, False))
+        out.cell_id = self._my_cell
+        for g in out.grants:
+            g.cell_id = self._my_cell
+        return out
+
+    def _pick_spill_peer(self) -> Optional[CellHandle]:
+        """Least-loaded peer cell that (a) is below the spillover rung
+        itself — never shift load onto a cell that is also shedding —
+        and (b) has free capacity right now.  Reads each peer's
+        load_signal() outside any federation lock (each call takes only
+        that dispatcher's own locks)."""
+        best: Optional[CellHandle] = None
+        best_util = float("inf")
+        for cell in self._cells:
+            if cell.cell_id == self._my_cell:
+                continue
+            d = cell.dispatcher
+            try:
+                if d.admission_rung() >= RUNG_SPILLOVER:
+                    continue
+                sig = d.load_signal()
+            except Exception:
+                continue  # cell mid-takeover: skip this round
+            if sig.free <= 0:
+                continue
+            if sig.utilization < best_util:
+                best, best_util = cell, sig.utilization
+        return best
+
+    def _spill_to(self, peer: CellHandle, env_digest: str,
+                  min_version: int, requestor: str, immediate: int,
+                  lease_s: float, timeout_s: float) -> RoutedGrants:
+        out = RoutedGrants(shard_id=0, cell_id=self._my_cell)
+        pairs = peer.dispatcher.wait_for_starting_new_task(
+            env_digest, min_version=min_version, requestor=requestor,
+            immediate=min(immediate, self._spill_max_batch), prefetch=0,
+            lease_s=lease_s,
+            # A spill is a detour on an already-ruled request: give the
+            # peer a short slice of the budget so a dry peer cannot eat
+            # the whole wait the delegate granted the home cell.
+            timeout_s=min(timeout_s, 1.0))
+        for gid, loc in pairs:
+            out.grants.append(RoutedGrant(
+                gid, loc, 0, False, cell_id=peer.cell_id, spilled=True))
+        if pairs:
+            self._bump("spilled_requests")
+            self._bump("spilled_grants", len(pairs))
+            logger.debug("spilled %d grant(s) cell %d -> %d",
+                         len(pairs), self._my_cell, peer.cell_id)
+        return out
+
+    # -- lease upkeep: route home by grant-id arithmetic ---------------------
+
+    def keep_task_alive(self, grant_ids: Sequence[int],
+                        next_keep_alive_s: float) -> List[bool]:
+        out = [False] * len(grant_ids)
+        by_cell: Dict[int, List[Tuple[int, int]]] = {}
+        for i, gid in enumerate(grant_ids):
+            by_cell.setdefault(self.cell_of(gid), []).append((i, gid))
+        for c, items in by_cell.items():
+            if c != self._my_cell:
+                self._bump("foreign_renewals", len(items))
+            try:
+                res = self._cells[c].dispatcher.keep_task_alive(
+                    [gid for _, gid in items], next_keep_alive_s)
+            except Exception:
+                # Owning cell mid-takeover: the renewal fails closed
+                # (False) and the delegate retries next beat — by then
+                # the standby has adopted the lease.
+                continue
+            for (i, _), ok in zip(items, res):
+                out[i] = ok
+        return out
+
+    def free_task(self, grant_ids: Sequence[int]) -> None:
+        by_cell: Dict[int, List[int]] = {}
+        for gid in grant_ids:
+            by_cell.setdefault(self.cell_of(gid), []).append(gid)
+        for c, ids in by_cell.items():
+            if c != self._my_cell:
+                self._bump("foreign_frees", len(ids))
+            try:
+                self._cells[c].dispatcher.free_task(ids)
+            except Exception:
+                pass  # lease expiry reclaims; free is best-effort
+
+    # -- lifecycle (local cell only) -----------------------------------------
+
+    def on_expiration_timer(self) -> None:
+        self._local().on_expiration_timer()
+
+    def stop(self) -> None:
+        self._local().stop()

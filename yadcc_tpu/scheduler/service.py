@@ -214,9 +214,12 @@ class SchedulerService:
         # on the same shard's ladder (an anonymous peer is routed
         # round-robin — two separate resolutions would rule on one
         # shard and queue on another).  A plain dispatcher has no
-        # resolve_home and takes the old path below.
+        # resolve_home and takes the old path below.  The env digest
+        # rides along for surface parity with the federation router
+        # (cell homing is digest-keyed for cache affinity; the shard
+        # router homes by requestor and ignores it).
         resolve_home = getattr(self.dispatcher, "resolve_home", None)
-        home = (resolve_home(ctx.peer)
+        home = (resolve_home(ctx.peer, req.env_desc.compiler_digest)
                 if resolve_home is not None else None)
         # Overload ladder (doc/robustness.md): rule BEFORE the request
         # queues.  Shedding is never silent — LOCAL_ONLY and REJECT
@@ -258,12 +261,16 @@ class SchedulerService:
             resp = api.scheduler.WaitForStartingTaskResponse(
                 degradation_rung=decision.rung,
                 shard_id=routed.shard_id,
-                stolen_grants=routed.stolen_count)
+                stolen_grants=routed.stolen_count,
+                cell_id=routed.cell_id,
+                spilled_grants=routed.spilled_count)
             for g in routed.grants:
                 resp.grants.add(task_grant_id=g.grant_id,
                                 servant_location=g.servant_location,
                                 shard_id=g.shard_id,
-                                stolen=g.stolen)
+                                stolen=g.stolen,
+                                cell_id=g.cell_id,
+                                spilled=g.spilled)
             return resp
         grants = self.dispatcher.wait_for_starting_new_task(
             req.env_desc.compiler_digest,

@@ -113,6 +113,12 @@ class RoutedGrant:
     servant_location: str
     shard_id: int          # shard whose dispatcher issued (owns) it
     stolen: bool           # True when shard_id != the serving shard
+    # Federation provenance (scheduler/federation.py): the cell whose
+    # dispatcher issued the grant, and whether it was spilled there
+    # from an overloaded home cell.  Single-cell planes leave the
+    # defaults — cell 0, nothing spilled.
+    cell_id: int = 0
+    spilled: bool = False
 
 
 @dataclass
@@ -121,6 +127,7 @@ class RoutedGrants:
 
     shard_id: int                  # home (serving) shard
     grants: List[RoutedGrant] = field(default_factory=list)
+    cell_id: int = 0               # home (serving) cell
 
     def pairs(self) -> List[Tuple[int, str]]:
         return [(g.grant_id, g.servant_location) for g in self.grants]
@@ -128,6 +135,10 @@ class RoutedGrants:
     @property
     def stolen_count(self) -> int:
         return sum(1 for g in self.grants if g.stolen)
+
+    @property
+    def spilled_count(self) -> int:
+        return sum(1 for g in self.grants if g.spilled)
 
 
 class ShardRouter:
@@ -151,11 +162,16 @@ class ShardRouter:
             raise ValueError("need at least one shard")
         n = len(shards)
         for k, d in enumerate(shards):
-            if (d._grant_id_stride != n
+            # A federated cell's shards carry a widened stride (cell
+            # count x shard count, scheduler/federation.py) — any
+            # positive multiple of N preserves the routing invariant
+            # shard_of_grant relies on: ids ≡ k+1 (mod N).
+            if (d._grant_id_stride % n != 0
                     or d._next_grant_id % n != (k + 1) % n):
                 raise ValueError(
-                    f"shard {k} must be built with grant_id_start={k + 1} "
-                    f"grant_id_stride={n} (use ShardRouter.build)")
+                    f"shard {k} must be built with grant_id_start ≡ "
+                    f"{k + 1} (mod {n}) and a stride that is a multiple "
+                    f"of {n} (use ShardRouter.build)")
         self._shards = list(shards)
         self._clock = clock
         self._cfg = steal or StealConfig()
@@ -215,18 +231,32 @@ class ShardRouter:
               clock: Clock = REAL_CLOCK,
               steal: Optional[StealConfig] = None,
               mesh=None,
+              grant_namespace: Tuple[int, int] = (0, 1),
               **dispatcher_kwargs) -> "ShardRouter":
         """Construct the N shard dispatchers with the grant-id
         namespacing the router requires.  ``policy_factory(k)`` builds
         shard k's DispatchPolicy (each shard owns its policy instance —
-        device kernels must not be shared across dispatch threads)."""
+        device kernels must not be shared across dispatch threads).
+
+        ``grant_namespace=(cell_index, n_cells)`` places the whole
+        router inside a federation's two-level id namespace
+        (scheduler/federation.py): cell c's shard k issues ids ≡
+        c*N + k + 1 (mod C*N).  Because c*N + k + 1 ≡ k + 1 (mod N),
+        within-cell routing (``shard_of_grant``) is untouched, while
+        ids stay disjoint ACROSS cells — the zero-double-run namespace
+        check a takeover is audited against.  The default (0, 1) is
+        the single-cell plane, bit-for-bit the pre-federation ids."""
+        cell, n_cells = grant_namespace
+        if not (0 <= cell < n_cells):
+            raise ValueError(
+                f"grant_namespace cell {cell} outside [0, {n_cells})")
         shards = [
             TaskDispatcher(
                 policy_factory(k),
                 max_servants=max_servants_per_shard,
                 clock=clock,
-                grant_id_start=k + 1,
-                grant_id_stride=n_shards,
+                grant_id_start=cell * n_shards + k + 1,
+                grant_id_stride=n_cells * n_shards,
                 **dispatcher_kwargs,
             )
             for k in range(n_shards)
@@ -253,7 +283,7 @@ class ShardRouter:
         membership churn (tests/test_shard_router.py invariants)."""
         return int(self._ring.pick(location)[len("shard"):])
 
-    def resolve_home(self, requestor: str) -> int:
+    def resolve_home(self, requestor: str, env_digest: str = "") -> int:
         """Home shard for a grant request: the requestor's consistent-
         hash shard (delegates are pinned, so their keep-alive/free
         traffic and their grants co-locate), round-robin when the
@@ -261,7 +291,13 @@ class ShardRouter:
         call, so a caller pairing an admission ruling with a grant
         request must resolve once and pass the shard to both (the
         ``home`` kwarg) — otherwise an anonymous request is ruled on
-        one shard's ladder and queued on another's."""
+        one shard's ladder and queued on another's.
+
+        ``env_digest`` is accepted for surface parity with the
+        federation router, which routes by the task's cache-key prefix
+        (cache-affinity cell placement); within one cell the requestor
+        pin is the better locality signal, so it is ignored here."""
+        del env_digest
         if requestor:
             return self.shard_for_location(requestor)
         with self._lock:
@@ -334,6 +370,19 @@ class ShardRouter:
         if home is None:
             home = self.resolve_home(requestor)
         return self._shards[home].admission_check(immediate, prefetch)
+
+    def admission_rung(self) -> int:
+        """Max rung over shards — the replication journal and the
+        federation spillover check treat the hottest shard as the
+        cell's degradation level (same convention as inspect())."""
+        return max(d.admission_rung() for d in self._shards)
+
+    def restore_admission_rung(self, rung: int) -> None:
+        """Warm-standby takeover: restart every shard's ladder at the
+        journaled rung (the journal records the max; restoring it on
+        all shards errs toward shedding for one update interval)."""
+        for d in self._shards:
+            d.restore_admission_rung(rung)
 
     def wait_for_starting_new_task(self, env_digest: str, *,
                                    min_version: int = 0,
@@ -430,6 +479,43 @@ class ShardRouter:
         for d in self._shards:
             out.extend(d.get_running_tasks())
         return out
+
+    def load_signal(self):
+        """Aggregate pool load across shards — the federation router's
+        peer-ranking signal (least-loaded cell for spillover)."""
+        from .task_dispatcher import LoadSignal
+
+        sigs = [d.load_signal() for d in self._shards]
+        cap = sum(s.capacity for s in sigs)
+        outstanding = sum(s.outstanding for s in sigs)
+        queued = sum(s.queued_immediate for s in sigs)
+        return LoadSignal(
+            capacity=cap,
+            outstanding=outstanding,
+            queued_immediate=queued,
+            utilization=((outstanding + queued) / cap) if cap > 0 else 0.0,
+            free=sum(s.free for s in sigs),
+        )
+
+    def adopt_grants(self, location: str,
+                     grants: Sequence[Tuple[int, str, str]],
+                     lease_s: float = 15.0) -> int:
+        """Warm-standby replay (scheduler/replication.py): route each
+        journaled grant to its owning shard by id."""
+        by_shard: Dict[int, List[Tuple[int, str, str]]] = defaultdict(list)
+        for item in grants:
+            by_shard[self.shard_of_grant(item[0])].append(item)
+        return sum(self._shards[s].adopt_grants(location, items, lease_s)
+                   for s, items in by_shard.items())
+
+    def set_adoption_window(self, floor_grant_id: int,
+                            grace_s: float, *,
+                            gap_slack: int = 1024) -> None:
+        """Open every shard's takeover grace window: any of them may be
+        the owner of a journal-gap grant a servant reports."""
+        for d in self._shards:
+            d.set_adoption_window(floor_grant_id, grace_s,
+                                  gap_slack=gap_slack)
 
     def on_expiration_timer(self) -> None:
         for d in self._shards:

@@ -7,4 +7,5 @@ loopback ports.  Used by tests/test_e2e.py and
 yadcc_tpu/tools/cluster_sim.py.
 """
 
+from .federated_cluster import FederatedCluster  # noqa: F401
 from .local_cluster import LocalCluster, make_fake_compiler  # noqa: F401

@@ -38,6 +38,12 @@ Scenarios (``cluster_sim --scenario <name>|all``):
                      parent's key at 1/width weight) must hold every
                      victim at >= 80% of its share, and every parent
                      must still complete with explicit verdicts
+    cell-kill        two federated cells, warm standby on cell 0;
+                     overload must spill to the peer BEFORE local-only
+                     degradation, then the active scheduler dies
+                     mid-spike and the standby must take over within
+                     one keep-alive interval with zero double-issued
+                     grants and every pre-kill lease renewable
 
 Each scenario returns a JSON-able dict with its measurements, its SLO
 bounds, and a per-bound pass flag; ``run_matrix`` aggregates them into
@@ -66,7 +72,7 @@ from ..scheduler.admission import (RUNG_NAMES, RUNG_NORMAL, RUNG_REJECT,
 
 SCENARIO_NAMES = ("wan-jitter", "burst", "flaky-servant", "slow-loris",
                   "oversized-tu", "cache-restart", "overload-ladder",
-                  "aot-storm")
+                  "aot-storm", "cell-kill")
 
 
 # --------------------------------------------------------------------------
@@ -523,7 +529,8 @@ def _scn_oversized_tu(smoke: bool) -> dict:
         cache_control=0,  # force the grant path for every task
         # Isolate fairness from admission: the ladder must not convert
         # the adversary's storm into LOCAL_ONLY verdicts here.
-        admission_config=AdmissionConfig(up_thresholds=(1e9, 1e9, 1e9)),
+        admission_config=AdmissionConfig(
+            up_thresholds=(1e9, 1e9, 1e9, 1e9)),
         task_timeout_s=120.0,
     )
     # Fairness dispersion: while the adversary saturates, victims each
@@ -593,7 +600,7 @@ def _scn_overload_ladder(smoke: bool) -> dict:
     tasks_per_thread = 3 if smoke else 6
     n_threads = 16  # vs pool capacity 4: the synthetic 4x overload
     cfg = AdmissionConfig(
-        up_thresholds=(1.2, 2.0, 3.0),
+        up_thresholds=(1.2, 1.6, 2.0, 3.0),
         up_dwell_s=0.15, down_dwell_s=0.6,
         demand_window_s=1.5,
         retry_after_base_ms=100, retry_after_max_ms=800)
@@ -752,6 +759,269 @@ def _scn_overload_ladder(smoke: bool) -> dict:
         # A REJECT answer is an immediate verdict, not a queue wait.
         "reject_p99_ms_max": 250.0,
     }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_cell_kill(smoke: bool) -> dict:
+    """Federation tentpole proof (doc/robustness.md "Failover state
+    machine"): two scheduler cells, warm standby on cell 0, a grant
+    storm aimed at cell 0's key range, then a kill -9 of cell 0's
+    active scheduler mid-spike.
+
+    Two claims, one artifact:
+
+    * **spillover before LOCAL_ONLY** — cell 0's ladder reaches
+      SPILLOVER and sheds grants to cell 1 (provenance stamped on the
+      wire: ``grants[].cell_id``/``spilled``) while the fleet's
+      success rate stays at 1.0 and nobody is told to compile locally;
+    * **failover ≤ one keep-alive interval** — the standby's silence
+      monitor promotes the mirror; storm clients ride the failover
+      URI list (active,standby) through NOT_SERVING refusals with
+      server-computed retry-after and land grants on the promoted
+      scheduler, with zero double-issued grant ids across the
+      takeover (the two-level namespace + adoption floor) and every
+      pre-kill grant renewable exactly once (lease adoption).
+    """
+    from .. import api
+    from ..rpc import Channel
+    from ..scheduler.admission import RUNG_SPILLOVER
+    from ..testing.federated_cluster import FederatedCluster
+
+    tasks_per_thread = 6 if smoke else 10
+    n_threads = 8 if smoke else 12
+    keep_alive_ms = 3000  # the failover SLO bound: one renewal interval
+    compile_s = 0.15
+    # Cell 0: tiny pool, ladder tuned to hit SPILLOVER early but
+    # LOCAL_ONLY only under absurd pressure — the rung between
+    # SHED_OPTIONAL and LOCAL_ONLY is the whole point.
+    cfg0 = AdmissionConfig(up_thresholds=(1.2, 1.6, 6.0, 9.0),
+                           up_dwell_s=0.05, down_dwell_s=0.6,
+                           demand_window_s=1.2,
+                           retry_after_base_ms=100,
+                           retry_after_max_ms=500)
+    fc = FederatedCluster(2, servants_per_cell=2, servant_capacity=1,
+                          env_digests=("env-fed",),
+                          admission_configs=[cfg0, None],
+                          streamer_interval_s=0.05,
+                          heartbeat_ms=400)
+    # The storm targets cell 0's key range: with one env digest the
+    # client-side CellDirectory pick is a constant; dial cell 0.
+    dial0 = fc.cell_dial_uri(0)
+
+    sweep_stop = threading.Event()
+
+    def sweeper():
+        while not sweep_stop.wait(0.2):
+            for r in fc.routers:
+                try:
+                    r.on_expiration_timer()
+                except Exception:
+                    pass  # mid-takeover: the handle swap is racy here
+
+    threading.Thread(target=sweeper, name="fed-sweep",
+                     daemon=True).start()
+
+    lock = threading.Lock()
+    issued: List[int] = []            # every grant id ever received
+    spilled_seen = [0]                # provenance-stamped spill grants
+    local_verdicts = [0]              # flow==1 answers (must stay 0)
+    results = {"remote": 0, "local": 0, "lost": 0}
+    first_grant_after_kill = [None]   # monotonic time of first success
+    adopted_renews = [0, 0]           # [ok, failed] renewals of
+    max_rung = [0]                    # pre-kill grants post-takeover
+
+    kill_evt = threading.Event()
+
+    def worker(idx: int) -> None:
+        chan = Channel(dial0)
+        for _ in range(tasks_per_thread):
+            deadline = time.monotonic() + 8.0
+            outcome = None
+            while outcome is None:
+                req = api.scheduler.WaitForStartingTaskRequest(
+                    token="", milliseconds_to_wait=250, immediate_reqs=1,
+                    next_keep_alive_in_ms=keep_alive_ms)
+                req.env_desc.compiler_digest = "env-fed"
+                flow, retry_s, grants = 0, 0.1, []
+                try:
+                    resp, _ = chan.call(
+                        "ytpu.SchedulerService", "WaitForStartingTask",
+                        req, api.scheduler.WaitForStartingTaskResponse,
+                        timeout=2.5)
+                    flow = resp.flow_control
+                    retry_s = (resp.retry_after_ms or 100) / 1000.0
+                    grants = list(resp.grants)
+                    with lock:
+                        max_rung[0] = max(max_rung[0],
+                                          resp.degradation_rung)
+                except RpcError:
+                    retry_s = 0.1  # active dead / standby pre-promote
+                if grants:
+                    g = grants[0]
+                    t_granted = time.monotonic()
+                    with lock:
+                        issued.append(g.task_grant_id)
+                        if g.spilled:
+                            spilled_seen[0] += 1
+                        if (kill_evt.is_set()
+                                and first_grant_after_kill[0] is None):
+                            first_grant_after_kill[0] = t_granted
+                    fc.note_run_start(g.servant_location,
+                                      g.task_grant_id)
+                    pre_kill = not kill_evt.is_set()
+                    time.sleep(compile_s)
+                    # A grant that straddled the kill is the adoption
+                    # proof: the promoted scheduler must honor its
+                    # lease exactly once.  Retry the renewal through
+                    # the standby's NOT_SERVING window (one keep-alive
+                    # interval budget), then free.
+                    straddled = pre_kill and kill_evt.is_set()
+                    renew_deadline = time.monotonic() + (
+                        keep_alive_ms / 1000.0 if straddled else 0.0)
+                    while True:
+                        try:
+                            kr = api.scheduler.KeepTaskAliveRequest(
+                                token="",
+                                task_grant_ids=[g.task_grant_id],
+                                next_keep_alive_in_ms=keep_alive_ms)
+                            kresp, _ = chan.call(
+                                "ytpu.SchedulerService",
+                                "KeepTaskAlive", kr,
+                                api.scheduler.KeepTaskAliveResponse,
+                                timeout=2.5)
+                            if (straddled and not kresp.statuses[0]
+                                    and time.monotonic()
+                                    < renew_deadline):
+                                # Journal-gap grant: the replica never
+                                # saw it, so renewal answers False
+                                # until the servant's next heartbeat
+                                # re-reports it inside the adoption
+                                # grace window.  Keep renewing — the
+                                # delegate's real retry discipline.
+                                time.sleep(0.15)
+                                continue
+                            if straddled:
+                                with lock:
+                                    adopted_renews[
+                                        0 if kresp.statuses[0]
+                                        else 1] += 1
+                            chan.call(
+                                "ytpu.SchedulerService", "FreeTask",
+                                api.scheduler.FreeTaskRequest(
+                                    token="",
+                                    task_grant_ids=[g.task_grant_id]),
+                                api.scheduler.FreeTaskResponse,
+                                timeout=2.5)
+                            break
+                        except RpcError:
+                            # Active dead / standby pre-promote: the
+                            # delegate keeps renewing until promote.
+                            if time.monotonic() >= renew_deadline:
+                                break  # lease expiry cleans up
+                            time.sleep(0.1)
+                    fc.note_run_end(g.servant_location, g.task_grant_id)
+                    outcome = "remote"
+                elif flow == 1:
+                    with lock:
+                        local_verdicts[0] += 1
+                    outcome = "local"
+                elif time.monotonic() > deadline:
+                    outcome = "local"  # survival contract: never hang
+                else:
+                    time.sleep(min(retry_s, 0.5))
+            with lock:
+                results[outcome] += 1
+        chan.close()
+
+    fc.arm_monitor(silence_s=0.5)
+    threads = [threading.Thread(target=worker, args=(i,),
+                                name=f"fed-storm-{i}", daemon=True)
+               for i in range(n_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # Let phase A (spillover under overload) run, then kill the
+    # active mid-spike — early enough that most of the storm still
+    # has to ride through the failover.
+    time.sleep(0.8 if smoke else 1.5)
+    rung_at_kill = fc.routers[0].admission_rung()
+    spill_stats_at_kill = fc.routers[0].stats()
+    t_kill = fc.kill_active()
+    kill_evt.set()
+
+    promoted = fc.wait_promoted(10.0)
+    for t in threads:
+        t.join(timeout=60)
+        if t.is_alive():
+            with lock:
+                results["lost"] += 1
+    storm_s = time.monotonic() - t0
+    sweep_stop.set()
+
+    report = fc.takeover_report or {}
+    post_stats = fc.routers[0].stats()
+    failover_ms = (
+        (first_grant_after_kill[0] - t_kill) * 1000.0
+        if first_grant_after_kill[0] is not None else None)
+    fc.stop()
+
+    total = n_threads * tasks_per_thread
+    survived = results["remote"] + results["local"]
+    dupes = len(issued) - len(set(issued))
+    out = {
+        "tasks": total,
+        "storm_threads": n_threads,
+        "storm_seconds": round(storm_s, 2),
+        "cells": 2,
+        "ok_remote": results["remote"],
+        "local_fallback": results["local"],
+        "lost_or_hung": results["lost"] + (total - survived
+                                           - results["lost"]),
+        "compile_success_rate": round(survived / total, 4),
+        # -- spillover (phase A) --
+        "rung_at_kill": rung_at_kill,
+        "max_rung_seen": max_rung[0],
+        "spilled_grants_stamped": spilled_seen[0],
+        "spilled_grants_at_kill": spill_stats_at_kill.get(
+            "spilled_grants", 0),
+        "spillover_engaged": int(
+            spill_stats_at_kill.get("spilled_grants", 0) > 0
+            or spilled_seen[0] > 0),
+        "local_only_verdicts": local_verdicts[0],
+        # -- failover (phase B) --
+        "promoted": int(promoted),
+        "failover_time_ms": (round(failover_ms, 1)
+                             if failover_ms is not None else None),
+        "keep_alive_interval_ms": keep_alive_ms,
+        "takeover_ms": round(report.get("takeover_ms", -1.0), 2),
+        "servants_replayed": report.get("servants_replayed", 0),
+        "grants_adopted": report.get("grants_adopted", 0),
+        "adoption_floor": report.get("adoption_floor", 0),
+        "restored_rung": report.get("restored_rung", -1),
+        "adopted_renewals_ok": adopted_renews[0],
+        "adopted_renewals_failed": adopted_renews[1],
+        # -- exactly-once accounting --
+        "grants_issued": len(issued),
+        "double_runs": dupes,
+        "foreign_frees_routed": post_stats.get("foreign_frees", 0),
+    }
+    slo = {
+        "compile_success_rate_min": 0.99,
+        "double_runs_max": 0,
+        "promoted_min": 1,
+        # A scheduler death costs one renewal interval, not the fleet.
+        "failover_time_ms_max": float(keep_alive_ms),
+        # Spillover is the rung BEFORE local-only: it must have
+        # engaged, and nobody may have been degraded to local compiles.
+        "spillover_engaged_min": 1,
+        "local_only_verdicts_max": 0,
+        "adopted_renewals_failed_max": 0,
+        "lost_or_hung_max": 0,
+    }
+    out["spillover_rung"] = RUNG_SPILLOVER  # what rung_at_kill is read against
     out["slo"] = slo
     out["slo_checks"] = _check_slo(out, slo)
     return out
@@ -948,6 +1218,7 @@ def run_scenario(name: str, smoke: bool = False) -> dict:
         "cache-restart": _scn_cache_restart,
         "overload-ladder": _scn_overload_ladder,
         "aot-storm": _scn_aot_storm,
+        "cell-kill": _scn_cell_kill,
     }[name]
     out = fn(smoke)
     out["scenario"] = name
@@ -970,13 +1241,17 @@ def run_matrix(names=None, smoke: bool = False) -> dict:
 
 def quick_hostile_metrics() -> dict:
     """bench.py's riding-along fields: the REJECT-verdict p99 from a
-    smoke overload ladder and the survival rate from a smoke
-    flaky-servant run."""
+    smoke overload ladder, the survival rate from a smoke
+    flaky-servant run, and the federation failover canaries from a
+    smoke cell-kill run."""
     ladder = run_scenario("overload-ladder", smoke=True)
     flaky = run_scenario("flaky-servant", smoke=True)
+    cellkill = run_scenario("cell-kill", smoke=True)
     return {
         "overload_reject_p99_ms": ladder["reject_p99_ms"],
         "survival_compile_success_rate": flaky["compile_success_rate"],
+        "failover_time_ms": cellkill["failover_time_ms"],
+        "cell_kill_success_rate": cellkill["compile_success_rate"],
     }
 
 
