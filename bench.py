@@ -470,6 +470,26 @@ def main() -> None:
     except Exception:
         aio_grant_p99 = None
 
+    # Full-async serving path canaries (ISSUE 16, doc/benchmarks.md
+    # "RPC front end"): the accept-p99 ratio of a small aio storm at
+    # --accept-loops 4 over 1 (must stay ~flat), and the parked
+    # WaitForCompilationOutput continuations a small servant rig holds
+    # at once with zero extra OS threads — the in-harness twins of
+    # artifacts/cluster_sim_50k.json.
+    try:
+        from yadcc_tpu.tools.cluster_sim import quick_accept_loops_scaling
+
+        accept_scaling = quick_accept_loops_scaling()
+    except Exception:
+        accept_scaling = None
+    try:
+        from yadcc_tpu.tools.cluster_sim import \
+            quick_servant_parked_waiters
+
+        servant_parked = quick_servant_parked_waiters()
+    except Exception:
+        servant_parked = None
+
     # Hostile-world survival canaries (tools/scenarios.py,
     # doc/robustness.md): the p99 latency of an explicit REJECT verdict
     # under a smoke 4x-overload ladder storm (a rejection is an
@@ -484,6 +504,15 @@ def main() -> None:
 
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 12 (r17+): adds `accept_loops_scaling` (accept p99
+        # ratio of a small aio connection storm at --accept-loops 4
+        # over 1 — the SO_REUSEPORT AioServerGroup must hold the accept
+        # tail ~flat) and `servant_parked_waiters` (parked
+        # WaitForCompilationOutput continuations a small aio servant
+        # rig holds at once with ZERO extra OS threads — the full-async
+        # serving path's park claim, tools/cluster_sim --servant-park;
+        # doc/benchmarks.md "RPC front end").  Every v11 field is still
+        # emitted.
         # Version 11 (r16+): adds `failover_time_ms` (kill-to-first-
         # granted-RPC through the warm-standby takeover in a smoke
         # cell-kill run, tools/scenarios.py; doc/robustness.md
@@ -537,7 +566,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 11,
+        "harness_version": 12,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -583,6 +612,8 @@ def main() -> None:
         "resident_policy_stage": resident_stage,
         "concurrent_connections": storm_conns,
         "grant_call_p99_ms": aio_grant_p99,
+        "accept_loops_scaling": accept_scaling,
+        "servant_parked_waiters": servant_parked,
         "overload_reject_p99_ms": hostile.get("overload_reject_p99_ms"),
         "survival_compile_success_rate": hostile.get(
             "survival_compile_success_rate"),

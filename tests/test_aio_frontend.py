@@ -626,6 +626,234 @@ class TestAsyncComponentApis:
 
 
 # ---------------------------------------------------------------------------
+# parked continuations: servant WaitForCompilationOutput
+# ---------------------------------------------------------------------------
+
+
+class TestParkedServantWait:
+    """The servant's long-poll is a parked continuation on the aio
+    front end (ISSUE 16): a waiting peer costs one closure in the
+    engine's waiter list, never a pool thread."""
+
+    @pytest.fixture
+    def rig(self, tmp_path, monkeypatch):
+        import pathlib
+
+        from yadcc_tpu.daemon.cloud.compiler_registry import \
+            CompilerRegistry
+        from yadcc_tpu.daemon.cloud.daemon_service import DaemonService
+        from yadcc_tpu.daemon.cloud.execution_engine import ExecutionEngine
+        from yadcc_tpu.daemon.config import DaemonConfig
+        from yadcc_tpu.rpc import (
+            register_mock_server,
+            unregister_mock_server,
+        )
+
+        monkeypatch.setenv("PATH", str(
+            pathlib.Path(__file__).parent / "testdata" / "toolchains"
+            / "bin"))
+        config = DaemonConfig(temporary_dir=str(tmp_path),
+                              location="127.0.0.1:8335")
+        engine = ExecutionEngine(max_concurrency=4,
+                                 min_memory_for_new_task=1)
+        svc = DaemonService(config, engine=engine,
+                            registry=CompilerRegistry(),
+                            allow_poor_machine=True, cgroup_present=False)
+        svc.set_acceptable_tokens_for_testing(["tok"])
+        srv = AioRpcServer("127.0.0.1:0")
+        svc.attach_frontend(srv)
+        spec = svc.spec()
+        assert "WaitForCompilationOutput" in spec.parked
+        srv.add_service(spec)
+        # The same spec, mounted on the mock transport, serves the
+        # blocking handler (sync servers only read spec.methods) —
+        # the two paths share one engine and one task table.
+        register_mock_server("parked-servant", spec)
+        ch = Channel(f"aio://127.0.0.1:{srv.port}")
+        yield svc, engine, ch
+        ch.close()
+        unregister_mock_server("parked-servant")
+        srv.stop()
+        engine.stop()
+
+    def _queue(self, ch, svc, source=b"int main(){return 0;}",
+               args="-O2"):
+        req = api.daemon.QueueCxxCompilationTaskRequest(
+            token="tok", task_grant_id=5, source_path="/src/x.cc",
+            invocation_arguments=args,
+            compression_algorithm=api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        req.env_desc.compiler_digest = svc.registry.environments()[0]
+        from yadcc_tpu.common import compress
+
+        resp, _ = ch.call(
+            "ytpu.DaemonService", "QueueCxxCompilationTask", req,
+            api.daemon.QueueCxxCompilationTaskResponse,
+            attachment=compress.compress(source))
+        return resp.task_id
+
+    def _wait(self, ch, task_id, wait_ms=8000):
+        req = api.daemon.WaitForCompilationOutputRequest(
+            token="tok", task_id=task_id, milliseconds_to_wait=wait_ms)
+        req.acceptable_compression_algorithms.append(
+            api.daemon.COMPRESSION_ALGORITHM_ZSTD)
+        return ch.call("ytpu.DaemonService", "WaitForCompilationOutput",
+                       req, api.daemon.WaitForCompilationOutputResponse,
+                       timeout=30)
+
+    def _drain(self, engine, timeout_s=15.0):
+        deadline = time.monotonic() + timeout_s
+        while (engine.inspect()["running"]
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+
+    def test_completion_before_wait_replies_immediately(self, rig):
+        svc, engine, ch = rig
+        task_id = self._queue(ch, svc)
+        self._drain(engine)
+        t0 = time.monotonic()
+        resp, att = self._wait(ch, task_id)
+        assert time.monotonic() - t0 < 2.0
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_DONE
+        assert resp.exit_code == 0
+        assert b".o" in bytes(att)
+
+    def test_wait_then_complete_wakes_parked(self, rig):
+        svc, engine, ch = rig
+        # The servant cmdline is `<cc> <args> -c -o <out> <src>`;
+        # splice a sleep in the middle and let a second fake-compiler
+        # invocation pick up the real `-c -o ...` tail.  Absolute
+        # sleep path: the rig's PATH holds only the fake toolchain.
+        task_id = self._queue(ch, svc,
+                              args="-O2 && /bin/sleep 1 && g++")
+        got = {}
+
+        def waiter():
+            got["resp"] = self._wait(ch, task_id, wait_ms=15000)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.4)
+        assert "resp" not in got  # parked, not answered
+        assert engine.inspect()["parked_waiters"] == 1
+        t.join(timeout=20)
+        resp, _ = got["resp"]
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_DONE
+        assert resp.exit_code == 0
+        assert engine.inspect()["parked_waiters"] == 0
+
+    def test_deadline_answers_running(self, rig):
+        svc, engine, ch = rig
+        task_id = self._queue(ch, svc,
+                              args="-O2 && /bin/sleep 3 && g++")
+        t0 = time.monotonic()
+        resp, _ = self._wait(ch, task_id, wait_ms=300)
+        assert 0.2 < time.monotonic() - t0 < 2.5
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_RUNNING
+        # The deadline path deregisters its waiter (cancel_wait): an
+        # expired long-poll must not sit in the table until completion
+        # — the peer re-polls with a fresh request.
+        assert engine.inspect()["parked_waiters"] == 0
+
+    def test_unknown_task_not_found_fast_path(self, rig):
+        _, _, ch = rig
+        t0 = time.monotonic()
+        resp, _ = self._wait(ch, 99999, wait_ms=8000)
+        assert time.monotonic() - t0 < 2.0
+        assert resp.status == api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+
+    def test_parked_output_byte_identical_to_sync_path(self, rig):
+        svc, engine, ch = rig
+        task_id = self._queue(ch, svc)
+        self._drain(engine)
+        parked_resp, parked_att = self._wait(ch, task_id)
+        sync_ch = Channel("mock://parked-servant")
+        sync_resp, sync_att = self._wait(sync_ch, task_id)
+        assert parked_resp.status \
+            == api.daemon.COMPILATION_TASK_STATUS_DONE
+        # Byte-identical: the whole response message and the packed
+        # output attachment, not just selected fields.
+        assert (parked_resp.SerializeToString(deterministic=True)
+                == sync_resp.SerializeToString(deterministic=True))
+        assert bytes(parked_att) == bytes(sync_att)
+
+
+# ---------------------------------------------------------------------------
+# AioServerGroup: N accept loops, one port
+# ---------------------------------------------------------------------------
+
+
+class TestAioServerGroup:
+    def _drive(self, srv, n_chans=6, calls=5):
+        chans = [Channel(f"aio://127.0.0.1:{srv.port}")
+                 for _ in range(n_chans)]
+        out = []
+        try:
+            for i, ch in enumerate(chans):
+                for j in range(calls):
+                    resp, att = ch.call(
+                        "t.Echo", "Do",
+                        api.scheduler.GetConfigRequest(token=f"{i}:{j}"),
+                        api.scheduler.GetConfigResponse,
+                        attachment=b"abc", timeout=15)
+                    out.append((resp.serving_daemon_token, bytes(att)))
+            insp = srv.inspect()
+        finally:
+            for ch in chans:
+                ch.close()
+        return sorted(out), insp
+
+    def test_multi_loop_parity_and_counter_aggregation(self):
+        from yadcc_tpu.rpc import make_rpc_server
+        from yadcc_tpu.rpc.aio_server import AioServerGroup
+
+        results = {}
+        for loops in (1, 4):
+            srv = make_rpc_server("aio", "127.0.0.1:0",
+                                  accept_loops=loops)
+            srv.add_service(_echo_spec())
+            srv.start()
+            try:
+                results[loops], insp = self._drive(srv)
+                assert insp["connections"] == 6
+                assert insp["double_replies"] == 0
+                if loops > 1:
+                    assert isinstance(srv, AioServerGroup)
+                    assert insp["accept_loops"] == loops
+                    assert len(insp["per_loop"]) == loops
+                    # The aggregate is exactly the per-loop sum.
+                    assert insp["connections"] == sum(
+                        p["connections"] for p in insp["per_loop"])
+                    assert insp["double_replies"] == sum(
+                        p["double_replies"] for p in insp["per_loop"])
+                    for k, p in enumerate(insp["per_loop"]):
+                        assert p["loop"] == f"aio-rpc-{k}"
+                        assert p["port"] == srv.port
+                        assert p["loop_lag_s"] < 1.0
+            finally:
+                srv.stop()
+        # Same workload, 1 vs 4 accept loops: identical results.
+        assert results[1] == results[4]
+
+    def test_group_call_later_and_bad_loop_count(self):
+        from yadcc_tpu.rpc.aio_server import AioServerGroup
+
+        with pytest.raises(ValueError):
+            AioServerGroup("127.0.0.1:0", accept_loops=0)
+        grp = AioServerGroup("127.0.0.1:0", accept_loops=2)
+        try:
+            fired = []
+            timers = [grp.call_later(0.02, fired.append, i)
+                      for i in range(4)]
+            deadline = time.monotonic() + 5
+            while len(fired) < 4 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sorted(fired) == [0, 1, 2, 3]
+            assert all(isinstance(t, LoopTimer) for t in timers)
+        finally:
+            grp.stop()
+
+
+# ---------------------------------------------------------------------------
 # reply-once at runtime: double replies are refused AND counted
 # ---------------------------------------------------------------------------
 

@@ -114,13 +114,30 @@ echo "== rpc front-end gates (byte parity + connection storm) =="
 # threaded transport over the smoke corpus (exit 2 = divergence), and
 # a small connection storm against the aio HTTP front end must lose no
 # client, keep a bounded accept p99, and complete its compile stream.
+# ISSUE 16 raised the storm to a MULTI-LOOP run (--accept-loops 2, the
+# SO_REUSEPORT AioServerGroup on every aio RPC server in the simulated
+# cluster); the smoke gate also asserts the loop-native steal path
+# still engages (stolen grants > 0 through the continuation-chained
+# donor ops).
 if ! python -m yadcc_tpu.tools.rpc_frontend_bench --parity-smoke; then
   echo "rpc front-end byte-parity smoke FAILED" >&2
   fail=1
 fi
 if ! python -m yadcc_tpu.tools.cluster_sim --clients 200 \
-       --rpc-frontend aio --smoke; then
-  echo "connection-storm smoke (aio) FAILED" >&2
+       --rpc-frontend aio --accept-loops 2 --smoke; then
+  echo "connection-storm smoke (aio, multi-loop) FAILED" >&2
+  fail=1
+fi
+# Full-async serving-path gates (ISSUE 16): thousands of parked
+# WaitForCompilationOutput long-polls must cost the servant ZERO extra
+# OS threads, and the steal-storm A/B must show pool-thread occupancy
+# decoupled from donor-wait concurrency on the async path.
+if ! python -m yadcc_tpu.tools.cluster_sim --servant-park 2000; then
+  echo "servant-park gate FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --steal-ab 48; then
+  echo "steal-storm A/B gate FAILED" >&2
   fail=1
 fi
 

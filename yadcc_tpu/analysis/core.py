@@ -190,7 +190,10 @@ class AnalyzerConfig:
                                            "device_pool.py")
     # Path fragments selecting the modules where aio-blocking applies
     # (the event-loop front end: coroutines there must never block).
-    aio_path_fragments: Tuple[str, ...] = ("rpc",)
+    # "cloud" pulls in daemon/cloud/ — the parked servant wait
+    # (WaitForCompilationOutputParked + ExecutionEngine's async
+    # completion surface) runs on the accept loop.
+    aio_path_fragments: Tuple[str, ...] = ("rpc", "cloud")
     # Path fragments (filename parts) selecting the dispatcher-cycle
     # modules where device-sync applies: the device-resident dispatch
     # hot loop, where any unsanctioned np.asarray/block_until_ready

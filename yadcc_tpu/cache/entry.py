@@ -66,6 +66,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         " or the event-loop server (clients then dial "
                         "aio://host:port; doc/scheduler.md \"RPC front "
                         "end\")")
+    p.add_argument("--accept-loops", type=int, default=1,
+                   help="aio front end only: shard the accept path "
+                        "across N SO_REUSEPORT event loops; "
+                        "1 = single loop")
     return p
 
 
@@ -114,7 +118,8 @@ def cache_server_start(args) -> None:
     exposed_vars.expose("yadcc/cache", service.inspect)
 
     server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}",
-                             max_workers=32)
+                             max_workers=32,
+                             accept_loops=args.accept_loops)
     server.add_service(service.spec())
     server.start()
     # aio front-end serving stats incl. `double_replies`, the runtime

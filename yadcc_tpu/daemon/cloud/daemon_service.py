@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ... import api
+from ...common.limits import clamp_wait_s
 from ...jit.env import JitEnvironment, default_jit_environments
 from ...rpc import Channel, RpcContext, RpcError, ServiceSpec
 from ...utils.logging import get_logger
@@ -93,8 +94,18 @@ class DaemonService:
         self._beat_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._sched_channel: Optional[Channel] = None
+        # Set by attach_frontend when serving on the aio front end;
+        # enables the parked WaitForCompilationOutput path.
+        self._frontend = None
 
     # -- wiring ------------------------------------------------------------
+
+    def attach_frontend(self, server) -> None:
+        """Hand the service its RPC front end BEFORE spec() so the
+        output long-poll can park: the front end supplies the loop
+        deadline timers (``call_later``).  Threaded front ends have no
+        timer surface — the sync path stays, as A/B and fallback."""
+        self._frontend = server if hasattr(server, "call_later") else None
 
     def spec(self) -> ServiceSpec:
         s = ServiceSpec(SERVICE_NAME)
@@ -116,6 +127,17 @@ class DaemonService:
               api.daemon.WaitForCompilationOutputRequest,
               self.WaitForCompilationOutput)
         s.add("FreeTask", api.daemon.FreeDaemonTaskRequest, self.FreeTask)
+        if self._frontend is not None and hasattr(
+                self.engine, "wait_for_task_async"):
+            # aio front end attached: the output long-poll parks ON the
+            # accept loop (engine continuation + loop deadline timer)
+            # instead of holding a worker thread in
+            # engine.wait_for_task.  Only the aio server consults
+            # `parked`; the threaded front end keeps the blocking
+            # handler above as A/B + fallback.
+            s.add_parked("WaitForCompilationOutput",
+                         api.daemon.WaitForCompilationOutputRequest,
+                         self.WaitForCompilationOutputParked)
         return s
 
     def _verify(self, token: str) -> None:  # ytpu: sanitizes(authz)
@@ -372,24 +394,26 @@ class DaemonService:
                            str(req.task_id))
         return api.daemon.ReferenceTaskResponse()
 
-    def WaitForCompilationOutput(self, req, attachment, ctx: RpcContext):  # ytpu: untrusted(req, attachment)
+    def _check_wait_request(self, req) -> None:
+        """Validation shared by the sync and parked wait paths."""
         self._verify(req.token)
         if api.daemon.COMPRESSION_ALGORITHM_ZSTD not in list(
                 req.acceptable_compression_algorithms or
                 [api.daemon.COMPRESSION_ALGORITHM_ZSTD]):
             raise RpcError(api.daemon.DAEMON_STATUS_INVALID_ARGUMENT,
                            "peer cannot accept zstd")
+
+    def _build_output_response(self, task_id: int, output,
+                               ctx: RpcContext):
+        """Turn a wait outcome into the response, shared by the sync
+        and parked paths so their replies stay byte-identical
+        (tested).  ``output`` is None while the task still runs."""
         resp = api.daemon.WaitForCompilationOutputResponse()
-        if not self.engine.is_known(req.task_id):
-            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
-            return resp
-        output = self.engine.wait_for_task(
-            req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
         if output is None:
             resp.status = api.daemon.COMPILATION_TASK_STATUS_RUNNING
             return resp
         with self._lock:
-            result = self._results.get(req.task_id)
+            result = self._results.get(task_id)
         if result is None:
             resp.status = api.daemon.COMPILATION_TASK_STATUS_FAILED
             return resp
@@ -408,6 +432,78 @@ class DaemonService:
         ctx.response_attachment = packing.pack_keyed_buffers_payload(
             result.files)
         return resp
+
+    def WaitForCompilationOutput(self, req, attachment, ctx: RpcContext):  # ytpu: untrusted(req, attachment)
+        self._check_wait_request(req)
+        if not self.engine.is_known(req.task_id):
+            resp = api.daemon.WaitForCompilationOutputResponse()
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+            return resp
+        output = self.engine.wait_for_task(
+            req.task_id, clamp_wait_s(req.milliseconds_to_wait, 10.0))
+        return self._build_output_response(req.task_id, output, ctx)
+
+    # ytpu: loop-only
+    def WaitForCompilationOutputParked(self, req, attachment, ctx,
+                                       done):  # ytpu: untrusted(req, attachment)  # ytpu: responder(done)
+        """Parked twin of WaitForCompilationOutput (aio front end
+        only).  Runs ON the accept loop: validation raises inline,
+        then the wait becomes an engine completion continuation plus a
+        loop deadline timer.  A servant holding 5k peer waiters holds
+        5k of these closures — zero pool threads.  ``done`` is
+        reply-once; whichever of completion/deadline fires second is a
+        counted no-op."""
+        self._check_wait_request(req)
+        if not self.engine.is_known(req.task_id):
+            resp = api.daemon.WaitForCompilationOutputResponse()
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+            done(resp)
+            return
+        replied: list = []
+        deadline_timer: list = []
+
+        def on_output(output) -> None:
+            # Completion continuation: the engine's waiter thread (or
+            # this loop, when the task already finished).  Response
+            # assembly is CPU-only; the attachment pack is the same
+            # work the sync path does on a pool thread.
+            replied.append(True)
+            if deadline_timer:
+                deadline_timer[0].cancel()
+            done(self._build_output_response(req.task_id, output, ctx))
+
+        def on_deadline() -> None:
+            # Same reply the sync path's timed-out wait produces.  Drop
+            # our waiter from the engine table first: the peer re-polls
+            # with a fresh request, so an expired continuation left
+            # behind would accumulate (waiters × re-polls stale
+            # closures on one slow compile).  Completion racing the
+            # removal is settled by the reply-once responder.
+            self.engine.cancel_wait(req.task_id, on_output)
+            resp = api.daemon.WaitForCompilationOutputResponse()
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_RUNNING
+            done(resp)
+
+        if not self.engine.wait_for_task_async(req.task_id, on_output):
+            # Freed/GC'd between is_known and registration: the sync
+            # path's unknown-id answer.
+            resp = api.daemon.WaitForCompilationOutputResponse()
+            resp.status = api.daemon.COMPILATION_TASK_STATUS_NOT_FOUND
+            done(resp)
+            return
+        if replied:
+            return  # answered inline (task already complete); no timer
+        # ONE clamp, shared with the sync path: the deadline timer
+        # derives from the same clamp_wait_s(..., 10.0) the blocking
+        # engine.wait_for_task call uses, so both front ends time out
+        # identically.
+        deadline_timer.append(self._frontend.call_later(
+            clamp_wait_s(req.milliseconds_to_wait, 10.0), on_deadline))
+        if replied:
+            # Completion won the race while the timer was being armed;
+            # done() already refused the second reply — just reap the
+            # timer (cancel is idempotent).
+            deadline_timer[0].cancel()
 
     def FreeTask(self, req, attachment, ctx):  # ytpu: untrusted(req, attachment)
         self._verify(req.token)

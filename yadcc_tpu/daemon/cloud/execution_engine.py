@@ -86,6 +86,10 @@ class _Task:
     completed_at: Optional[float] = None
     output: Optional[TaskOutput] = None
     done: threading.Event = field(default_factory=threading.Event)
+    # Parked continuations from wait_for_task_async, fired on
+    # completion.  Guarded by the engine lock.
+    waiters: List[Callable[[TaskOutput], None]] = field(
+        default_factory=list)
 
 
 class ExecutionEngine:
@@ -178,7 +182,20 @@ class ExecutionEngine:
         with self._lock:
             task.output = output
             task.completed_at = time.monotonic()
+            waiters = task.waiters
+            task.waiters = []
         task.done.set()
+        # Parked continuations fire AFTER on_completion and done.set():
+        # the owning service populates its result table inside
+        # on_completion, so by the time a continuation runs the result
+        # is ready — same ordering a blocking wait_for_task observes.
+        for on_done in waiters:
+            try:
+                on_done(output)
+            except Exception:
+                logger.exception(
+                    "parked wait continuation failed for task %d",
+                    task.task_id)
 
     # -- querying ------------------------------------------------------------
 
@@ -207,6 +224,47 @@ class ExecutionEngine:
             return None
         task.done.wait(timeout=timeout_s)
         return task.output
+
+    def wait_for_task_async(self, task_id: int, on_done) -> bool:  # ytpu: responder(on_done)  # ytpu: allow(reply-drop)  # unknown id: the False return hands the reply back to the caller, which answers NOT_FOUND (mirrors DistributedTaskDispatcher.wait_for_task_async)
+        """Loop-native twin of :meth:`wait_for_task`: registers a
+        completion continuation instead of blocking a thread.
+
+        Returns False when the task id is unknown (caller replies
+        NOT_FOUND).  Otherwise ``on_done(output)`` fires exactly once —
+        immediately (from this thread) when the task already completed,
+        else from the task's waiter thread at completion.  A parked
+        peer costs this closure, zero pool threads."""
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                return False
+            if task.output is None:
+                task.waiters.append(on_done)
+                return True
+            output = task.output
+        # Completed already: fire outside the lock (the continuation
+        # replies on the RPC front end; never under the engine lock).
+        on_done(output)
+        return True
+
+    def cancel_wait(self, task_id: int, on_done) -> bool:
+        """Deregister a parked continuation whose deadline already
+        answered.  Without this, every expired long-poll would sit in
+        the waiter table until the task completes (the peer re-polls
+        with a FRESH request, so at storm scale one slow compile would
+        accumulate waiters × re-polls stale closures, all refused at
+        completion).  False when the continuation already left the
+        table — completion is firing it concurrently; the reply-once
+        responder settles that race."""
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                return False
+            try:
+                task.waiters.remove(on_done)
+                return True
+            except ValueError:
+                return False
 
     def is_known(self, task_id: int) -> bool:
         with self._lock:
@@ -284,4 +342,11 @@ class ExecutionEngine:
                     if t.completed_at is not None),
                 "tasks_run_ever": self.tasks_run_ever,
                 "rejected": self._rejected,
+                # Parked WaitForCompilationOutput continuations.  A
+                # deadline-expired waiter stays registered until the
+                # task completes (its reply-once guard makes the late
+                # fire a no-op) — same accepted slack as the local
+                # dispatcher's waiter table.
+                "parked_waiters": sum(len(t.waiters)
+                                      for t in self._tasks.values()),
             }

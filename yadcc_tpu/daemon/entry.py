@@ -123,6 +123,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(acquire_quota, wait_for_*) park as loop "
                         "continuations, and peer servants are dialed "
                         "aio:// (fleet-wide choice)")
+    p.add_argument("--accept-loops", type=int, default=1,
+                   help="aio front end only: shard the servant RPC "
+                        "accept path across N SO_REUSEPORT event "
+                        "loops (doc/daemon.md \"RPC front end\"); "
+                        "1 = single loop")
     return p
 
 
@@ -203,7 +208,8 @@ def daemon_start(args) -> None:
                      args.extra_compiler_bundle_dirs.split(",") if d])
     engine = ExecutionEngine(max_concurrency=max(capacity, 1))
     servant_server = make_rpc_server(args.rpc_frontend,
-                                     f"0.0.0.0:{args.serving_port}")
+                                     f"0.0.0.0:{args.serving_port}",
+                                     accept_loops=args.accept_loops)
     config.location = args.location or \
         f"{_guess_local_ip(args.scheduler_uri)}:{servant_server.port}"
     config_keeper = ConfigKeeper(cell_uri, args.token)
@@ -226,6 +232,9 @@ def daemon_start(args) -> None:
         config, engine=engine, registry=registry, cache_writer=cache_writer,
         sampler=sampler, allow_poor_machine=args.allow_poor_machine,
         cgroup_present=cgroup_present, jit_environments=jit_envs)
+    # Before spec(): an aio front end parks WaitForCompilationOutput on
+    # the accept loop (engine continuation + loop deadline timer).
+    service.attach_frontend(servant_server)
     servant_server.add_service(service.spec())
     servant_server.start()
 

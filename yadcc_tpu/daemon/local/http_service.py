@@ -293,15 +293,20 @@ class LocalHttpService:
         responder.release_request()  # parked: keep the continuation only
 
         def on_deadline() -> None:
-            # Still running at the poll window's end: 503, client
-            # re-polls (threaded-route semantics).  The completion
-            # continuation racing us is settled by the reply-once
-            # responder.
-            responder._reply(
-                404 if not self.dispatcher.is_known(task_id) else 503)
+            # Still running at the poll window's end: 503 + Retry-After,
+            # client re-polls (threaded-route semantics).  The
+            # completion continuation racing us is settled by the
+            # reply-once responder.
+            if not self.dispatcher.is_known(task_id):
+                responder._reply(404)
+            else:
+                responder._reply(503, retry_after_s=0.5)
 
+        # ONE clamp: the deadline timer derives from the same
+        # clamp_wait_s(..., 10.0) the threaded route's blocking wait
+        # uses, so both front ends time out identically.
         deadline_timer.append(self._aio.call_later(
-            min(req.milliseconds_to_wait, 10_000) / 1000.0, on_deadline))
+            clamp_wait_s(req.milliseconds_to_wait, 10.0), on_deadline))
 
     def _finish_wait_pooled(self, responder, task_type, task_id: int,
                             result) -> None:  # ytpu: responder(responder)
@@ -394,10 +399,12 @@ class LocalHttpService:
     def _wait_for_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         req = _from_json(task_type.wait_request_cls, body)
         result = self.dispatcher.wait_for_task(
-            req.task_id, min(req.milliseconds_to_wait, 10_000) / 1000.0)
+            req.task_id, clamp_wait_s(req.milliseconds_to_wait, 10.0))
         if result is None:
-            handler._reply(
-                404 if not self.dispatcher.is_known(req.task_id) else 503)
+            if not self.dispatcher.is_known(req.task_id):
+                handler._reply(404)
+            else:
+                handler._reply(503, retry_after_s=0.5)
             return
         resp, out_chunks = task_type.build_wait_response(result)
         self.dispatcher.free_task(req.task_id)

@@ -46,12 +46,22 @@ __all__ = [
 ]
 
 
-def make_rpc_server(frontend: str, address: str, *, max_workers: int = 32):
+def make_rpc_server(frontend: str, address: str, *, max_workers: int = 32,
+                    accept_loops: int = 1):
     """Factory for the `--rpc-frontend aio|threaded` flag: "threaded" is
     the grpc thread-pool server (the long-standing default, kept
     verbatim as the A/B + fallback), "aio" the event-loop front end
-    (rpc/aio_server.py, doc/scheduler.md "RPC front end")."""
+    (rpc/aio_server.py, doc/scheduler.md "RPC front end").
+
+    ``accept_loops`` > 1 shards the aio accept path across N
+    SO_REUSEPORT event loops (AioServerGroup); the threaded front end
+    ignores it — its pool is the concurrency knob."""
     if frontend == "aio":
+        if accept_loops > 1:
+            from .aio_server import AioServerGroup
+
+            return AioServerGroup(address, accept_loops=accept_loops,
+                                  max_workers=max_workers)
         from .aio_server import AioRpcServer
 
         return AioRpcServer(address, max_workers=max_workers)

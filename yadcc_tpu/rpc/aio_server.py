@@ -45,6 +45,7 @@ regresses to the thread-per-connection latency profile it replaces.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import struct
 import threading
 import time as _time
@@ -164,25 +165,50 @@ def _envelope_segments(seq: int, payload_segments: List[bytes]) -> List:
 # ---------------------------------------------------------------------------
 
 
+# Cadence of the always-on per-loop liveness tick (lag_s below).  One
+# timer per loop at 4Hz — cheap enough to leave on in production, which
+# is the point: looplag.installed() only watches during tests, while a
+# stalled accept loop must be visible on /inspect/vars in the field.
+_TICK_INTERVAL_S = 0.25
+
+
 class EventLoopThread:
     """One asyncio loop on one daemon thread, shared by any number of
-    servers.  ``--rpc-frontend aio`` processes run exactly one of these
-    (optionally N with SO_REUSEPORT — see AioRpcServer(reuse_port=));
+    servers.  ``--rpc-frontend aio`` processes run one of these per
+    accept loop (N with SO_REUSEPORT — see AioServerGroup);
     tests create and dispose of them freely."""
 
     def __init__(self, name: str = "aio-loop"):
+        self.name = name
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._run, name=name, daemon=True)
         self._started = threading.Event()
+        self._last_tick = _time.monotonic()
         self._thread.start()
         self._started.wait(5.0)
         looplag.register(self.loop, name)
+        try:
+            self.loop.call_soon_threadsafe(self._tick)
+        except RuntimeError:
+            pass  # loop already closed (teardown race in tests)
 
     def _run(self) -> None:
         asyncio.set_event_loop(self.loop)
         self.loop.call_soon(self._started.set)
         self.loop.run_forever()
+
+    # ytpu: loop-only
+    def _tick(self) -> None:
+        self._last_tick = _time.monotonic()
+        if not self.loop.is_closed():
+            self.loop.call_later(_TICK_INTERVAL_S, self._tick)  # ytpu: allow(async-timer-leak)  # self-rearming liveness tick: it dies with the loop, there is never anything to cancel
+
+    def lag_s(self) -> float:
+        """Seconds the loop is overdue for its liveness tick; ~0.0 on a
+        healthy loop, grows while a handler stalls it."""
+        return max(0.0,
+                   _time.monotonic() - self._last_tick - _TICK_INTERVAL_S)
 
     def run_sync(self, coro, timeout: float = 10.0):
         """Run a coroutine on the loop from a foreign thread, blocking
@@ -238,7 +264,12 @@ class LoopTimer:
             handle, self._handle = self._handle, None
         if handle is not None:
             # TimerHandle.cancel is not thread-safe; hop to the loop.
-            self._loops.call_soon(handle.cancel)
+            # A loop already stopped (teardown racing a completion
+            # continuation) has no timers left to fire — nothing to do.
+            try:
+                self._loops.call_soon(handle.cancel)
+            except RuntimeError:
+                pass
 
     @property
     def cancelled(self) -> bool:
@@ -397,7 +428,8 @@ class AioRpcServer:
         with self._stats_lock:
             doubles = self._double_replies
         return {"connections": self.connection_count(),
-                "double_replies": doubles, "port": self.port}
+                "double_replies": doubles, "port": self.port,
+                "loop_lag_s": round(self.loops.lag_s(), 4)}
 
     # -- dispatch (loop thread) ----------------------------------------------
 
@@ -494,6 +526,94 @@ class AioRpcServer:
         timer = LoopTimer(self.loops)
         self.loops.call_soon(timer._arm, delay_s, fn, args)
         return timer
+
+
+class AioServerGroup:
+    """N accept loops on ONE port: each loop owns a full ``AioRpcServer``
+    bound with ``SO_REUSEPORT``, so the kernel shards incoming
+    connections across loops and every connection's parser, parked
+    continuations and deadline timers live on the loop that accepted it
+    — no cross-loop state, no shared accept lock.
+
+    Mirrors the shard router's aggregation contract: ``inspect()``
+    returns the sum of the per-loop counters plus a ``per_loop`` list,
+    and the sum must equal what a single-loop server would report for
+    the same workload (tested).  The group quacks like ``AioRpcServer``
+    (``port`` / ``add_service`` / ``start`` / ``stop`` / ``call_later``
+    / ``connection_count`` / ``inspect``) so entries and ``LocalCluster``
+    swap it in via ``make_rpc_server(..., accept_loops=N)``.
+    """
+
+    def __init__(self, address: str = "127.0.0.1:0", *,
+                 accept_loops: int = 2, max_workers: int = 8):
+        if accept_loops < 1:
+            raise ValueError(f"accept_loops must be >= 1, "
+                             f"got {accept_loops}")
+        self.accept_loops = accept_loops
+        # The pool exists only for non-parked methods; split it so the
+        # group's total worker count matches a single-loop server's.
+        per_workers = max(1, max_workers // accept_loops)
+        host, _, port = address.rpartition(":")
+        host = host or "127.0.0.1"
+        self._loops: List[EventLoopThread] = []
+        self._servers: List[AioRpcServer] = []
+        bind_port = int(port)
+        for i in range(accept_loops):
+            loops = EventLoopThread(name=f"aio-rpc-{i}")
+            server = AioRpcServer(f"{host}:{bind_port}", loops=loops,
+                                  max_workers=per_workers,
+                                  reuse_port=True)
+            # Loop 0 resolves ":0"; the rest must land on the same port
+            # for SO_REUSEPORT to shard instead of scatter.
+            bind_port = server.port
+            self._loops.append(loops)
+            self._servers.append(server)
+        self.port = self._servers[0].port
+        self.stage_timer = self._servers[0].stage_timer
+        self._rr = itertools.count()
+
+    def add_service(self, spec: ServiceSpec) -> None:
+        # One ServiceSpec shared by all loops: specs are read-only after
+        # registration and handlers hand thread-safety to the owning
+        # component, exactly as with a single server.
+        for server in self._servers:
+            server.add_service(spec)
+
+    def start(self) -> None:
+        pass  # serving from construction; GrpcServer parity
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        for server in self._servers:
+            server.stop(grace)
+        # The servers were handed their loops, so they did not stop
+        # them (_own_loops is False); the group owns loop lifetime.
+        for loops in self._loops:
+            loops.stop()
+
+    def call_later(self, delay_s: float, fn, *args) -> LoopTimer:
+        """Timer for component-side deadlines that are not tied to a
+        connection (connection-bound timers arm on the dispatching
+        server's own loop).  Round-robins across loops so a timer storm
+        does not pile onto loop 0."""
+        server = self._servers[next(self._rr) % len(self._servers)]
+        return server.call_later(delay_s, fn, *args)
+
+    def connection_count(self) -> int:
+        return sum(s.connection_count() for s in self._servers)
+
+    def inspect(self) -> Dict[str, object]:
+        per_loop = []
+        for i, server in enumerate(self._servers):
+            entry = dict(server.inspect())
+            entry["loop"] = f"aio-rpc-{i}"
+            per_loop.append(entry)
+        return {
+            "connections": sum(e["connections"] for e in per_loop),
+            "double_replies": sum(e["double_replies"] for e in per_loop),
+            "port": self.port,
+            "accept_loops": self.accept_loops,
+            "per_loop": per_loop,
+        }
 
 
 # ---------------------------------------------------------------------------
@@ -1062,7 +1182,8 @@ class AioHttpServer:
         with self._stats_lock:
             doubles = self._double_replies
         return {"connections": self.connection_count(),
-                "double_replies": doubles, "port": self.port}
+                "double_replies": doubles, "port": self.port,
+                "loop_lag_s": round(self.loops.lag_s(), 4)}
 
     def start(self) -> None:
         pass

@@ -49,6 +49,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "long-polls park as continuations instead of "
                         "worker threads; delegates/daemons then dial "
                         "aio://host:port")
+    p.add_argument("--accept-loops", type=int, default=1,
+                   help="aio front end only: shard the accept path "
+                        "across N SO_REUSEPORT event loops "
+                        "(doc/scheduler.md \"RPC front end\"); "
+                        "1 = single loop")
     p.add_argument("--shards", type=int, default=1,
                    help="scheduler control-plane shards (doc/scheduler.md "
                         "\"Sharded control plane\"): N>1 partitions the "
@@ -230,7 +235,8 @@ def scheduler_standby_start(args) -> None:
     dispatcher = build_dispatcher(args)  # warmed NOW, replayed at takeover
 
     standby = StandbyScheduler(token=args.replication_token)
-    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}")
+    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}",
+                             accept_loops=args.accept_loops)
     server.add_service(standby.receiver.spec())
     server.add_service(standby.gate.spec())
     server.start()
@@ -306,7 +312,8 @@ def scheduler_start(args) -> None:
     gc_guard = LatencyGcGuard()
     gc_guard.start()
 
-    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}")
+    server = make_rpc_server(args.rpc_frontend, f"0.0.0.0:{args.port}",
+                             accept_loops=args.accept_loops)
     server.add_service(service.spec())
     server.start()
     # aio front-end serving stats incl. `double_replies`, the runtime

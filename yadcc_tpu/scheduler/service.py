@@ -105,9 +105,11 @@ class SchedulerService:
         # Parked long-poll twin for the aio front end (doc/scheduler.md
         # "RPC front end"): a waiting delegate is a pending-table entry
         # plus the loop's continuation, not a parked worker thread.
-        # Registered only when the dispatcher grew the submit API (the
-        # sharded router routes/steals inside the blocking path and
-        # keeps the worker-pool fallback).
+        # Registered only when the dispatcher grew the submit API —
+        # plain dispatchers and the sharded router both have it now;
+        # the router's submit path routes/steals via continuation-
+        # chained donor ops (submit_wait_for_starting_new_task_routed),
+        # so donor waits no longer hold worker threads either.
         if hasattr(self.dispatcher, "submit_wait_for_starting_new_task"):
             s.add_parked("WaitForStartingTask",
                          api.scheduler.WaitForStartingTaskRequest,
@@ -310,15 +312,64 @@ class SchedulerService:
         if not req.env_desc.compiler_digest:
             raise RpcError(api.scheduler.SCHEDULER_STATUS_INVALID_ARGUMENT,
                            "missing env_desc")
+        # Sharded control plane: one home resolution for admission AND
+        # the grant path, mirroring the blocking handler above.
+        resolve_home = getattr(self.dispatcher, "resolve_home", None)
+        home = (resolve_home(ctx.peer, req.env_desc.compiler_digest)
+                if resolve_home is not None else None)
         decision = self.dispatcher.admission_check(
             immediate=req.immediate_reqs or 1,
             prefetch=req.prefetch_reqs,
-            requestor=ctx.peer)
+            requestor=ctx.peer,
+            **({} if home is None else {"home": home}))
         if decision.flow != admission.FLOW_NONE:
             done(api.scheduler.WaitForStartingTaskResponse(
                 flow_control=decision.flow,
                 retry_after_ms=decision.retry_after_ms,
                 degradation_rung=decision.rung))
+            return
+        # Routed planes park with full provenance: the continuation
+        # receives RoutedGrants (donor ops chained loop-natively inside
+        # the router) and answers with the same shard/steal/cell fields
+        # as the blocking routed branch.
+        routed_submit = getattr(
+            self.dispatcher, "submit_wait_for_starting_new_task_routed",
+            None)
+        if routed_submit is not None:
+
+            def on_routed(routed):
+                if not routed.grants:
+                    done(None, error=RpcError(
+                        api.scheduler.SCHEDULER_STATUS_NO_QUOTA_AVAILABLE,
+                        "no capacity for environment"))
+                    return
+                resp = api.scheduler.WaitForStartingTaskResponse(
+                    degradation_rung=decision.rung,
+                    shard_id=routed.shard_id,
+                    stolen_grants=routed.stolen_count,
+                    cell_id=routed.cell_id,
+                    spilled_grants=routed.spilled_count)
+                for g in routed.grants:
+                    resp.grants.add(task_grant_id=g.grant_id,
+                                    servant_location=g.servant_location,
+                                    shard_id=g.shard_id,
+                                    stolen=g.stolen,
+                                    cell_id=g.cell_id,
+                                    spilled=g.spilled)
+                done(resp)
+
+            routed_submit(
+                req.env_desc.compiler_digest,
+                min_version=max(req.min_version, self._min_version),
+                requestor=ctx.peer,
+                immediate=req.immediate_reqs or 1,
+                prefetch=(req.prefetch_reqs
+                          if decision.prefetch_allowed else 0),
+                lease_s=lease_ms / 1000.0,
+                timeout_s=wait_ms / 1000.0,
+                home=home,
+                on_done=on_routed,
+            )
             return
 
         def on_done(grants):

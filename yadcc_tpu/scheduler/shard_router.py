@@ -454,6 +454,104 @@ class ShardRouter:
                 out.grants.append(RoutedGrant(gid, loc, home, False))
         return out
 
+    def submit_wait_for_starting_new_task(
+            self, env_digest: str, *,
+            min_version: int = 0,
+            requestor: str = "",
+            immediate: int = 1,
+            prefetch: int = 0,
+            lease_s: float = 15.0,
+            timeout_s: float = 5.0,
+            on_done) -> None:  # ytpu: responder(on_done)
+        """Loop-native twin of :meth:`wait_for_starting_new_task`:
+        fires ``on_done([(grant_id, location)])`` exactly once.  Its
+        presence is what enables the scheduler's parked registration
+        on routed (sharded) planes."""
+        self.submit_wait_for_starting_new_task_routed(
+            env_digest, min_version=min_version, requestor=requestor,
+            immediate=immediate, prefetch=prefetch, lease_s=lease_s,
+            timeout_s=timeout_s,
+            on_done=lambda routed: on_done(routed.pairs()))
+
+    def submit_wait_for_starting_new_task_routed(
+            self, env_digest: str, *,
+            min_version: int = 0,
+            requestor: str = "",
+            immediate: int = 1,
+            prefetch: int = 0,
+            lease_s: float = 15.0,
+            timeout_s: float = 5.0,
+            home: Optional[int] = None,
+            on_done) -> None:  # ytpu: responder(on_done)
+        """Async twin of :meth:`wait_for_starting_new_task_routed`:
+        the same steal-first plan, but every wait is a parked
+        continuation — donor ops chain through
+        :meth:`_try_steal_async` (no 50ms blocking poll on a worker
+        thread) and the home remainder parks on the home dispatcher's
+        pending queue.  Steal predicate, op bound (one per shard per
+        request), batch clamp, pacing, channel bound and backoff all
+        carry over unchanged from the sync path (parity-oracle
+        tested).  Exactly one ``on_done(RoutedGrants)`` fires."""
+        if home is None:
+            home = self.resolve_home(requestor)
+        d = self._shards[home]
+        out = RoutedGrants(shard_id=home)
+        state = {"need": max(0, immediate), "ops": 0}
+        t0 = self._clock.now()
+
+        def on_home(pairs) -> None:
+            for gid, loc in pairs:
+                out.grants.append(RoutedGrant(gid, loc, home, False))
+            on_done(out)
+
+        def finish() -> None:
+            # Same remainder rule as the sync path — prefetch is never
+            # stolen, only home-queued.  Unlike the sync path this
+            # always goes through the home submit: with zero immediate
+            # demand left and no prefetch the dispatcher's empty-
+            # demand fast path answers [] inline, which is the same
+            # outcome without a second reply shape to audit.
+            remaining = max(0.0, timeout_s - (self._clock.now() - t0))
+            d.submit_wait_for_starting_new_task(
+                env_digest, min_version=min_version,
+                requestor=requestor, immediate=state["need"],
+                prefetch=prefetch, lease_s=lease_s,
+                timeout_s=remaining, on_done=on_home)
+
+        steal = False
+        if self._cfg.enabled and state["need"] > 0 \
+                and len(self._shards) > 1:
+            sig = d.load_signal()
+            steal = sig.queued_immediate + state["need"] > sig.free
+        if not steal:
+            finish()
+            return
+        max_ops = len(self._shards) - 1
+
+        def next_op() -> None:
+            if state["need"] <= 0 or state["ops"] >= max_ops:
+                finish()
+                return
+            state["ops"] += 1
+            self._try_steal_async(
+                home, env_digest, min_version, requestor,
+                min(state["need"], self._cfg.max_batch), lease_s,
+                on_got)
+
+        def on_got(got) -> None:
+            # A dry/paced/full op ends the steal phase, exactly like
+            # the sync loop's `if not got: break`.  Chain depth is
+            # bounded by max_ops even when donors answer inline.
+            if not got:
+                finish()
+                return
+            for gid, loc, donor in got:
+                out.grants.append(RoutedGrant(gid, loc, donor, True))
+                state["need"] -= 1
+            next_op()
+
+        next_op()
+
     def keep_task_alive(self, grant_ids: Sequence[int],
                         next_keep_alive_s: float) -> List[bool]:
         out = [False] * len(grant_ids)
@@ -628,6 +726,74 @@ class ShardRouter:
             return [(gid, loc, donor) for gid, loc in got]
         finally:
             self._steal_sem.release()
+
+    def _try_steal_async(self, home: int, env_digest: str,
+                         min_version: int, requestor: str, want: int,
+                         lease_s: float,
+                         on_got) -> None:  # ytpu: responder(on_got)
+        """Async twin of :meth:`_try_steal`: identical pacing /
+        channel-bound / donor-pick / stats semantics, but the donor
+        wait parks on the donor dispatcher's pending queue instead of
+        blocking this thread for up to ``donor_timeout_s``.  The
+        channel semaphore is released by the donor continuation
+        (not a ``finally`` on return — the op outlives this frame).
+        Fires ``on_got([(grant_id, location, donor_shard)])`` exactly
+        once; empty on pacing/full/no-donor, like the sync path."""
+        cfg = self._cfg
+        now = self._clock.now()
+        with self._lock:
+            paced = now < self._steal_next_ok[home]
+            if paced:
+                self._stats["steal_paced"] += 1
+        if paced:
+            on_got([])
+            return
+        if not self._steal_sem.acquire(blocking=False):
+            with self._lock:
+                self._stats["steal_channel_full"] += 1
+            on_got([])
+            return
+        try:
+            donor, donor_free = self._pick_donor(home, now)
+        except Exception:
+            self._steal_sem.release()
+            raise
+        if donor is None:
+            with self._lock:
+                self._stats["steal_no_donor"] += 1
+            self._note_dry_locked_free(home, now)
+            self._steal_sem.release()
+            on_got([])
+            return
+        with self._lock:
+            self._stats["steals_attempted"] += 1
+
+        def on_donor(pairs) -> None:
+            # Donor continuation (the donor's dispatch thread, or
+            # inline when its inline leader satisfied us).  Settle the
+            # stats and the channel slot first, then hand up.
+            try:
+                if pairs:
+                    with self._lock:
+                        self._stats["stolen_grants"] += len(pairs)
+                        self._steal_backoffs[home].reset()
+                        self._steal_next_ok[home] = 0.0
+                        # The donor's free capacity just moved; make
+                        # the next donor pick see it.
+                        self._loads_at = -1.0
+                else:
+                    with self._lock:
+                        self._stats["steal_dry"] += 1
+                    self._note_dry_locked_free(home, self._clock.now())
+            finally:
+                self._steal_sem.release()
+            on_got([(gid, loc, donor) for gid, loc in pairs])
+
+        self._shards[donor].submit_wait_for_starting_new_task(
+            env_digest, min_version=min_version, requestor=requestor,
+            immediate=min(want, donor_free), prefetch=0,
+            lease_s=lease_s, timeout_s=cfg.donor_timeout_s,
+            on_done=on_donor)
 
     def _note_dry_locked_free(self, home: int, now: float) -> None:
         with self._lock:

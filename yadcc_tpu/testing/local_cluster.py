@@ -87,7 +87,8 @@ class _Servant:
     def __init__(self, cluster: "LocalCluster", tmp: pathlib.Path,
                  index: int, max_concurrency: int,
                  compiler_dirs: List[str]):
-        self.server = make_rpc_server(cluster.rpc_frontend, "127.0.0.1:0")
+        self.server = make_rpc_server(cluster.rpc_frontend, "127.0.0.1:0",
+                                      accept_loops=cluster.accept_loops)
         config = DaemonConfig(
             scheduler_uri=cluster.sched_uri,
             cache_server_uri=cluster.cache_uri,
@@ -122,6 +123,10 @@ class _Servant:
             # (YTPU_JIT_FAKE_WORKER=1 short-circuits the actual XLA
             # invocation for control-plane tests and the simulator).
             jit_environments=[local_jit_environment("cpu")])
+        # Production wiring (daemon/entry.py): the front end is
+        # attached BEFORE spec(), so an aio rig servant registers the
+        # parked WaitForCompilationOutput path.
+        self.service.attach_frontend(self.server)
         self.server.add_service(self.service.spec())
         self.server.start()
 
@@ -158,10 +163,14 @@ class LocalCluster:
         # "grpc"/"threaded" is the long-standing default.
         rpc_frontend: str = "grpc",
         http_frontend: Optional[str] = None,
+        # aio only: shard every control-plane server's accept path
+        # across N SO_REUSEPORT event loops (AioServerGroup).
+        accept_loops: int = 1,
     ):
         self.rpc_frontend = "threaded" if rpc_frontend == "grpc" \
             else rpc_frontend
         self._scheme = "aio" if self.rpc_frontend == "aio" else "grpc"
+        self.accept_loops = accept_loops
         http_frontend = http_frontend or (
             "aio" if self.rpc_frontend == "aio" else "threaded")
         # Single-process rig: self-avoidance must be off, or the
@@ -175,7 +184,8 @@ class LocalCluster:
             batch_window_s=0.0, admission_config=admission_config)
         self.sched = SchedulerService(self.sched_dispatcher)
         self.sched_server = make_rpc_server(self.rpc_frontend,
-                                            "127.0.0.1:0")
+                                            "127.0.0.1:0",
+                                            accept_loops=accept_loops)
         self.sched_server.add_service(self.sched.spec())
         self.sched_server.start()
         self.sched_uri = \
@@ -186,7 +196,8 @@ class LocalCluster:
             l2_engine if l2_engine is not None else DiskCacheEngine(
                 [ShardSpec(str(tmp / "l2"), 1 << 30)]))
         self.cache_server = make_rpc_server(self.rpc_frontend,
-                                            "127.0.0.1:0")
+                                            "127.0.0.1:0",
+                                            accept_loops=accept_loops)
         self.cache_server.add_service(self.cache_service.spec())
         self.cache_server.start()
         self.cache_uri = \
@@ -252,7 +263,8 @@ class LocalCluster:
         if down_for_s > 0:
             time.sleep(down_for_s)
         self.cache_server = make_rpc_server(self.rpc_frontend,
-                                            f"127.0.0.1:{port}")
+                                            f"127.0.0.1:{port}",
+                                            accept_loops=self.accept_loops)
         self.cache_server.add_service(self.cache_service.spec())
         self.cache_server.start()
 
