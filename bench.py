@@ -502,8 +502,28 @@ def main() -> None:
     except Exception:
         hostile = {}
 
+    # Three-level cache canaries (tools/scenarios.py cold-region smoke,
+    # doc/benchmarks.md "Cold-region rebuild"): the hit rate a cold
+    # region reaches purely through async L3 read-through promotion,
+    # and the prefetch arm's wall time to 90% of the warm region's
+    # steady hit rate.
+    try:
+        from yadcc_tpu.tools.scenarios import quick_coldregion_metrics
+
+        coldregion = quick_coldregion_metrics()
+    except Exception:
+        coldregion = {}
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 13 (r18+): adds `l3_read_through_hit_rate` (final hit
+        # rate of the prefetch-OFF cold-region arm — a region with
+        # empty L1/L2 warming purely via the shared L3 bucket's async
+        # read-through promotion) and `prefetch_time_to_warm_s` (wall
+        # seconds for the trace-prefetched arm to hold 90% of the warm
+        # region's steady hit rate over a rolling window;
+        # tools/scenarios.py cold-region smoke, doc/benchmarks.md
+        # "Cold-region rebuild").  Every v12 field is still emitted.
         # Version 12 (r17+): adds `accept_loops_scaling` (accept p99
         # ratio of a small aio connection storm at --accept-loops 4
         # over 1 — the SO_REUSEPORT AioServerGroup must hold the accept
@@ -566,7 +586,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 12,
+        "harness_version": 13,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -619,6 +639,10 @@ def main() -> None:
             "survival_compile_success_rate"),
         "failover_time_ms": hostile.get("failover_time_ms"),
         "cell_kill_success_rate": hostile.get("cell_kill_success_rate"),
+        "l3_read_through_hit_rate": coldregion.get(
+            "l3_read_through_hit_rate"),
+        "prefetch_time_to_warm_s": coldregion.get(
+            "prefetch_time_to_warm_s"),
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

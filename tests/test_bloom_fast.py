@@ -234,3 +234,74 @@ class TestFusedDevicePipeline:
         want = filt.may_contain_batch(probe)
         assert np.array_equal(got, want)
         assert rep.may_contain_batch([]).shape == (0,)
+
+    @pytest.fixture(scope="class")
+    def fleet_filt(self, filt):
+        """The cascade's second level: same geometry (num_bits), its own
+        salt and key population — keys a PEER region uploaded to L3."""
+        f = bloom.SaltedBloomFilter(num_bits=filt.num_bits, num_hashes=7,
+                                    salt=0x5EED0F1E)
+        f.add_many([f"ytpu-jit1-entry-{i:05d}" for i in range(1500)])
+        # Overlap: some keys live in both levels, as they do in
+        # production (a promoted entry is in L1/L2 AND L3).
+        f.add_many([f"ytpu-cxx2-entry-{i:05d}" for i in range(300)])
+        return f
+
+    @pytest.mark.parametrize("mesh_shape", ["1d", "2d"])
+    def test_sharded_cascade_parity(self, filt, fleet_filt, mesh_shape):
+        """The two-filter cascade launch on the virtual 8-device mesh is
+        bit-equal to the host reference `region OR fleet` — including
+        keys present in only one level, both, and neither (the
+        AND-before-OR reduction order is what this pins down: a key
+        each filter rejects on a *different* device must not pass)."""
+        import jax.numpy as jnp
+
+        from yadcc_tpu.ops.bloom_pipeline import seed_pair
+        from yadcc_tpu.ops.xxh64_jax import pack_keys
+        from yadcc_tpu.parallel import mesh as pmesh
+
+        mesh = (pmesh.make_mesh(8) if mesh_shape == "1d"
+                else pmesh.make_mesh_2d(2, 4))
+        keys = ([f"ytpu-cxx2-entry-{i:05d}" for i in range(64)]   # region
+                + [f"ytpu-jit1-entry-{i:05d}" for i in range(64)]  # fleet
+                + [f"ytpu-cxx2-entry-{i:05d}" for i in range(200, 264)]
+                + [f"ytpu-none-entry-{i:05d}" for i in range(64)])  # absent
+        length = 21
+        packed = jnp.asarray(pack_keys([k.encode() for k in keys],
+                                       length))
+        fn = pmesh.sharded_bloom_cascade_fn(
+            mesh, length=length, num_bits=filt.num_bits,
+            num_hashes_region=filt.num_hashes,
+            num_hashes_fleet=fleet_filt.num_hashes)
+        rw = pmesh.bloom_words_padded(filt.words, mesh, filt.num_bits)
+        fw = pmesh.bloom_words_padded(fleet_filt.words, mesh,
+                                      fleet_filt.num_bits)
+        got = np.asarray(fn(jnp.asarray(rw), jnp.asarray(fw), packed,
+                            seed_pair(filt.salt),
+                            seed_pair(fleet_filt.salt)))
+        want = filt.may_contain_batch(keys) \
+            | fleet_filt.may_contain_batch(keys)
+        assert got[:192].all() and not got.all()
+        assert np.array_equal(got, want)
+
+    def test_device_cascade_wrapper_parity(self, filt, fleet_filt):
+        """DeviceBloomCascade (the reader-facing wrapper, buckets mixed
+        key lengths) matches the host OR over a variable-length batch."""
+        from yadcc_tpu.cache.bloom_filter_generator import (
+            DeviceBloomCascade)
+
+        cas = DeviceBloomCascade()
+        probe = ([f"ytpu-cxx2-entry-{i:05d}" for i in range(30)]
+                 + [f"ytpu-jit1-entry-{i:05d}" for i in range(30)]
+                 + [f"ytpu-x-{i}" for i in range(30)]   # shorter class
+                 + ["ytpu-" + "z" * 40])                 # longer class
+        got = cas.may_contain_batch(filt, fleet_filt, probe)
+        want = filt.may_contain_batch(probe) \
+            | fleet_filt.may_contain_batch(probe)
+        assert np.array_equal(got, want)
+        assert got[:60].all() and not got.all()
+        assert cas.may_contain_batch(filt, fleet_filt, []).shape == (0,)
+        mismatched = bloom.SaltedBloomFilter(num_bits=1009, num_hashes=3,
+                                             salt=1)
+        with pytest.raises(ValueError):
+            cas.may_contain_batch(filt, mismatched, ["ytpu-k"])

@@ -499,3 +499,229 @@ class TestCacheService:
         assert resp.incremental  # established client: pacing applies
         resp, att = self._fetch(peer, service, 0, 0)  # fresh daemon, same ip
         assert not resp.incremental and att
+
+
+class TestFsBackendCrashMidPut:
+    def test_failed_put_leaves_no_tmp_residue(self, tmp_path):
+        """A put that dies mid-write (disk full, kill -9 analogue) must
+        not strand its temp file: the finally-cleanup removes it, and
+        even a listing taken BEFORE cleanup never surfaces tmp names as
+        keys (they carry the filtered `.tmp.` prefix)."""
+        backend = FsObjectStoreBackend(str(tmp_path))
+        backend.put("good", b"data")
+
+        real_write = type(tmp_path).write_bytes
+
+        def dying_write(self, data):
+            real_write(self, data[: len(data) // 2])
+            raise OSError(28, "No space left on device")
+
+        with pytest.raises(OSError):
+            with pytest.MonkeyPatch.context() as mp:
+                mp.setattr(type(tmp_path), "write_bytes", dying_write)
+                backend.put("doomed", b"payload-that-dies")
+        # No residue on disk at all — the half-written temp is gone.
+        assert [p.name for p in tmp_path.iterdir()] == ["good"]
+        assert [n for n, _ in backend.list_objects()] == ["good"]
+        # The target name was never created.
+        assert backend.get("doomed") is None
+
+    def test_listing_mid_put_never_surfaces_tmp_names(self, tmp_path):
+        """A peer listing the bucket WHILE a put is in flight (temp file
+        exists, rename not yet done) sees only committed objects — the
+        engine never manufactures keys from `.tmp.` names."""
+        backend = FsObjectStoreBackend(str(tmp_path))
+        backend.put("committed", b"x")
+        # Freeze the in-flight state a crashed writer would leave.
+        (tmp_path / ".tmp.inflight.12345").write_bytes(b"partial")
+        assert [n for n, _ in backend.list_objects()] == ["committed"]
+        eng = ObjectStoreEngine(backend, resync_interval_s=0.0)
+        assert eng.keys() == ["committed"]
+
+
+def _make_l3_service(tmp_path, tag, bucket, l3=None, **kw):
+    """A CacheService with its own L1/L2 and a (shared) L3 over
+    `bucket`, mounted on mock://cache-{tag}."""
+    l3 = l3 if l3 is not None else ObjectStoreEngine(
+        FsObjectStoreBackend(str(bucket)), resync_interval_s=0.0)
+    svc = CacheService(
+        InMemoryCache(1 << 20),
+        DiskCacheEngine([ShardSpec(str(tmp_path / f"l2-{tag}"), 1 << 20)]),
+        l3=l3,
+        user_tokens=TokenVerifier(["user"]),
+        servant_tokens=TokenVerifier(["servant"]),
+        **kw,
+    )
+    register_mock_server(f"cache-{tag}", svc.spec())
+    return svc, Channel(f"mock://cache-{tag}")
+
+
+def _put(ch, key, data=b"OBJ"):
+    ch.call("ytpu.CacheService", "PutEntry",
+            api.cache.PutEntryRequest(token="servant", key=key),
+            api.cache.PutEntryResponse, attachment=data)
+
+
+def _get(ch, key):
+    _, att = ch.call("ytpu.CacheService", "TryGetEntry",
+                     api.cache.TryGetEntryRequest(token="user", key=key),
+                     api.cache.TryGetEntryResponse)
+    return bytes(att)
+
+
+class TestL3Tier:
+    @pytest.fixture
+    def rig(self, tmp_path):
+        bucket = tmp_path / "bucket"
+        bucket.mkdir()
+        svc, ch = _make_l3_service(tmp_path, "a", bucket)
+        yield svc, ch, bucket
+        svc.stop()
+        unregister_mock_server("cache-a")
+
+    def test_put_writes_back_to_l3(self, rig):
+        svc, ch, _ = rig
+        _put(ch, "ytpu-cxx2-entry-k1")
+        assert svc.drain_l3_for_testing()
+        assert svc.l3.try_get("ytpu-cxx2-entry-k1") == b"OBJ"
+        assert svc.bloom_l3.may_contain("ytpu-cxx2-entry-k1")
+        assert svc.inspect()["l3"]["writebacks"] == 1
+
+    def test_miss_promotes_from_l3_async(self, rig):
+        svc, ch, _ = rig
+        # Entry exists ONLY in L3 (a foreign write).
+        svc.l3.put("ytpu-cxx2-entry-k2", b"FOREIGN")
+        with pytest.raises(RpcError) as ei:
+            _get(ch, "ytpu-cxx2-entry-k2")  # first read: NOT_FOUND...
+        assert ei.value.status == api.cache.CACHE_STATUS_NOT_FOUND
+        assert svc.drain_l3_for_testing()  # ...but the promote lands
+        assert _get(ch, "ytpu-cxx2-entry-k2") == b"FOREIGN"
+        assert svc.l1.try_get("ytpu-cxx2-entry-k2") == b"FOREIGN"
+        assert svc.l2.try_get("ytpu-cxx2-entry-k2") == b"FOREIGN"
+        assert svc.bloom.may_contain("ytpu-cxx2-entry-k2")
+        assert svc.inspect()["l3"]["hits"] == 1
+
+    def test_reply_path_never_blocks_on_slow_l3(self, tmp_path):
+        """The stage-timer assertion behind the acceptance criterion:
+        with an L3 whose every backend call takes ~200ms, TryGetEntry
+        misses must still answer in single-digit milliseconds — the
+        bucket round trip rides the background pool, and the promotion
+        still lands."""
+        import time as _time
+
+        bucket = tmp_path / "bucket"
+        bucket.mkdir()
+
+        class SlowBackend(FsObjectStoreBackend):
+            def get(self, name):
+                _time.sleep(0.2)
+                return super().get(name)
+
+            def put(self, name, data):
+                _time.sleep(0.2)
+                super().put(name, data)
+
+        slow = ObjectStoreEngine(SlowBackend(str(bucket)),
+                                 resync_interval_s=1e9)
+        slow.put("ytpu-cxx2-entry-slow", b"DEEP")  # pays 200ms once, here
+        svc, ch = _make_l3_service(tmp_path, "slow", bucket, l3=slow)
+        try:
+            for _ in range(3):
+                with pytest.raises(RpcError):
+                    _get(ch, "ytpu-cxx2-entry-slow")
+            assert svc.drain_l3_for_testing(timeout_s=30.0)
+            # Worst reply wall time stays far below one backend call.
+            assert svc.inspect()["tryget_reply_ms_max"] < 100.0
+            assert _get(ch, "ytpu-cxx2-entry-slow") == b"DEEP"
+        finally:
+            svc.stop()
+            unregister_mock_server("cache-slow")
+
+    def test_writeback_dedup_against_peer_upload(self, rig):
+        svc, ch, _ = rig
+        # A peer already uploaded this entry and our resync view saw it.
+        svc.l3.put("ytpu-cxx2-entry-k3", b"PEER")
+        _put(ch, "ytpu-cxx2-entry-k3", b"PEER")
+        assert svc.drain_l3_for_testing()
+        ins = svc.inspect()["l3"]
+        assert ins["writeback_dedup"] == 1 and ins["writebacks"] == 0
+        # Dedup still records the key in the fleet filter.
+        assert svc.bloom_l3.may_contain("ytpu-cxx2-entry-k3")
+
+    def test_pending_cap_sheds_not_queues(self, tmp_path):
+        bucket = tmp_path / "bucket2"
+        bucket.mkdir()
+        svc, ch = _make_l3_service(tmp_path, "cap", bucket,
+                                   l3_pending_cap=0)
+        try:
+            _put(ch, "ytpu-cxx2-entry-shed")
+            assert svc.drain_l3_for_testing()
+            ins = svc.inspect()["l3"]
+            assert ins["shed"] == 1 and ins["writebacks"] == 0
+            # The entry still serves from L1/L2 — shedding L3 work
+            # never loses data, only durability/sharing.
+            assert _get(ch, "ytpu-cxx2-entry-shed") == b"OBJ"
+        finally:
+            svc.stop()
+            unregister_mock_server("cache-cap")
+
+    def test_fleet_filter_rpc_not_found_without_l3(self, service):
+        ch = Channel("mock://cache")
+        with pytest.raises(RpcError) as ei:
+            ch.call("ytpu.CacheService", "FetchFleetBloomFilter",
+                    api.cache.FetchBloomFilterRequest(token="user"),
+                    api.cache.FetchBloomFilterResponse)
+        assert ei.value.status == api.cache.CACHE_STATUS_NOT_FOUND
+
+    # Reuse TestCacheService's two-level fixture for the no-L3 case.
+    service = TestCacheService.service
+
+
+class TestSharedBucketConvergence:
+    """Satellite: two regional CacheServices over ONE Fs bucket."""
+
+    @pytest.fixture
+    def pair(self, tmp_path):
+        bucket = tmp_path / "bucket"
+        bucket.mkdir()
+        a, cha = _make_l3_service(tmp_path, "A", bucket)
+        b, chb = _make_l3_service(tmp_path, "B", bucket)
+        yield a, cha, b, chb
+        a.stop()
+        b.stop()
+        unregister_mock_server("cache-A")
+        unregister_mock_server("cache-B")
+
+    def test_write_on_a_hits_on_b_within_one_resync(self, pair):
+        a, cha, b, chb = pair
+        _put(cha, "ytpu-cxx2-entry-conv", b"FROM-A")
+        assert a.drain_l3_for_testing()
+        # B has never seen the key: first read misses but schedules the
+        # L3 promote (B's engine re-lists on its resync interval — 0 in
+        # this rig — so the foreign object is visible immediately).
+        with pytest.raises(RpcError):
+            _get(chb, "ytpu-cxx2-entry-conv")
+        assert b.drain_l3_for_testing()
+        assert _get(chb, "ytpu-cxx2-entry-conv") == b"FROM-A"
+
+    def test_bloom_on_b_includes_a_key_after_resync(self, pair):
+        a, cha, b, chb = pair
+        _put(cha, "ytpu-cxx2-entry-bloomed", b"X")
+        assert a.drain_l3_for_testing()
+        assert not b.bloom_l3.may_contain("ytpu-cxx2-entry-bloomed")
+        # The 60s rebuild timer body: resync listing -> fleet filter.
+        b.rebuild_bloom_filter()
+        assert b.bloom_l3.may_contain("ytpu-cxx2-entry-bloomed")
+
+    def test_b_put_of_a_entry_deduped(self, pair):
+        a, cha, b, chb = pair
+        _put(cha, "ytpu-cxx2-entry-dup", b"SAME")
+        assert a.drain_l3_for_testing()
+        # B's resync view must know the object before its own fill of
+        # the same entry, so the write-back dedups instead of
+        # re-uploading (keys() re-lists — the convergence path).
+        b.l3.keys()
+        _put(chb, "ytpu-cxx2-entry-dup", b"SAME")
+        assert b.drain_l3_for_testing()
+        assert b.inspect()["l3"]["writeback_dedup"] == 1
+        assert b.inspect()["l3"]["writebacks"] == 0

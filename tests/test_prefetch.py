@@ -1,0 +1,193 @@
+"""Trace-driven prefetcher: budget, shed, sanitizer, trace round trip."""
+
+import json
+
+import pytest
+
+from yadcc_tpu.cache.disk_engine import DiskCacheEngine
+from yadcc_tpu.cache.in_memory_cache import InMemoryCache
+from yadcc_tpu.cache.object_store_engine import (
+    FsObjectStoreBackend,
+    ObjectStoreEngine,
+)
+from yadcc_tpu.cache.prefetcher import (
+    TracePrefetcher,
+    load_and_warm,
+    sanitize_prefetch_key,
+)
+from yadcc_tpu.cache.service import CacheService
+from yadcc_tpu.common.disk_cache import ShardSpec
+from yadcc_tpu.scheduler.admission import RUNG_NORMAL, RUNG_SHED_OPTIONAL
+from yadcc_tpu.tools.trace_replay import generate_key_trace, load_key_trace
+
+
+class _FakeClock:
+    """monotonic/sleep pair where sleep advances time instantly."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.slept = 0.0
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+        self.slept += s
+
+
+def _service(tmp_path, tag="w"):
+    bucket = tmp_path / f"bucket-{tag}"
+    bucket.mkdir(exist_ok=True)
+    l3 = ObjectStoreEngine(FsObjectStoreBackend(str(bucket)),
+                           resync_interval_s=0.0)
+    return CacheService(
+        InMemoryCache(1 << 20),
+        DiskCacheEngine([ShardSpec(str(tmp_path / f"l2-{tag}"), 1 << 20)]),
+        l3=l3)
+
+
+class TestSanitizer:
+    def test_key_domain(self):
+        assert sanitize_prefetch_key("ytpu-cxx2-entry-ab") \
+            == "ytpu-cxx2-entry-ab"
+        assert sanitize_prefetch_key("../../etc/passwd") is None
+        assert sanitize_prefetch_key("other-prefix") is None
+        assert sanitize_prefetch_key(42) is None
+        assert sanitize_prefetch_key(None) is None
+
+    def test_size_cap(self):
+        assert sanitize_prefetch_key("ytpu-" + "x" * 600) is None
+        assert sanitize_prefetch_key("ytpu-" + "x" * 100) is not None
+
+
+class TestTracePrefetcher:
+    def test_warm_plants_l1_l2_and_bloom(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            keys = [f"ytpu-sim-entry-{i}" for i in range(5)]
+            for k in keys:
+                svc.l3.put(k, b"V" * 100)
+            stats = TracePrefetcher(svc, clock=_FakeClock()).warm(keys)
+            assert stats["fetched"] == 5
+            for k in keys:
+                assert svc.l1.try_get(k) == b"V" * 100
+                assert svc.l2.try_get(k) == b"V" * 100
+                assert svc.bloom.may_contain(k)
+        finally:
+            svc.stop()
+
+    def test_skips_present_missing_and_invalid(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            svc.l3.put("ytpu-sim-entry-cold", b"C")
+            svc.l1.put("ytpu-sim-entry-warm", b"W")
+            stats = TracePrefetcher(svc, clock=_FakeClock()).warm([
+                "ytpu-sim-entry-cold",
+                "ytpu-sim-entry-cold",       # trace repeat: deduped
+                "ytpu-sim-entry-warm",       # already resident
+                "ytpu-sim-entry-gone",       # aged out of L3
+                "evil://not-a-key",          # sanitizer reject
+            ])
+            assert stats["fetched"] == 1
+            assert stats["skipped_present"] == 1
+            assert stats["missing"] == 1
+            assert stats["skipped_invalid"] == 1
+        finally:
+            svc.stop()
+
+    def test_entry_cap_stops_sweep(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            keys = [f"ytpu-sim-entry-{i}" for i in range(10)]
+            for k in keys:
+                svc.l3.put(k, b"x")
+            stats = TracePrefetcher(svc, max_entries=3,
+                                    clock=_FakeClock()).warm(keys)
+            assert stats["fetched"] == 3
+        finally:
+            svc.stop()
+
+    def test_bytes_per_s_throttle_sleeps(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            keys = [f"ytpu-sim-entry-{i}" for i in range(4)]
+            for k in keys:
+                svc.l3.put(k, b"B" * 1000)
+            clk = _FakeClock()
+            TracePrefetcher(svc, bytes_per_s=1000,
+                            clock=clk).warm(keys)
+            # 4000 bytes at 1000 B/s must have slept ~4s of debt
+            # (sleeps advance the fake clock, capped at 1s each).
+            assert clk.slept >= 3.0
+        finally:
+            svc.stop()
+
+    def test_sheds_at_shed_optional(self, tmp_path):
+        """Prefetch is the FIRST traffic to shed: any rung at or above
+        SHED_OPTIONAL pauses the sweep, per-key probed so pressure that
+        clears mid-sweep lets the tail proceed."""
+        svc = _service(tmp_path)
+        try:
+            keys = [f"ytpu-sim-entry-{i}" for i in range(6)]
+            for k in keys:
+                svc.l3.put(k, b"x")
+            rungs = iter([RUNG_NORMAL, RUNG_SHED_OPTIONAL,
+                          RUNG_SHED_OPTIONAL, RUNG_NORMAL,
+                          RUNG_NORMAL, RUNG_NORMAL])
+            stats = TracePrefetcher(
+                svc, rung_probe=lambda: next(rungs),
+                clock=_FakeClock()).warm(keys)
+            assert stats["skipped_shed"] == 2
+            assert stats["fetched"] == 4
+        finally:
+            svc.stop()
+
+    def test_no_l3_is_a_noop(self, tmp_path):
+        svc = CacheService(
+            InMemoryCache(1 << 20),
+            DiskCacheEngine([ShardSpec(str(tmp_path / "l2"), 1 << 20)]))
+        stats = TracePrefetcher(svc, clock=_FakeClock()).warm(
+            ["ytpu-sim-entry-0"])
+        assert stats["fetched"] == 0
+
+
+class TestKeyTrace:
+    def test_generate_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "keys.jsonl")
+        universe = generate_key_trace(path, keys=20, draws=200, seed=3)
+        stream = load_key_trace(path)
+        assert len(stream) == 200
+        assert set(stream) <= set(universe)
+        # Zipf skew: the most popular key dominates.
+        top = max(set(stream), key=stream.count)
+        assert stream.count(top) > 200 / 20
+
+    def test_loader_sanitizes_and_caps(self, tmp_path):
+        path = tmp_path / "dirty.jsonl"
+        lines = [
+            json.dumps({"kind": "key", "key": "ytpu-sim-entry-ok"}),
+            json.dumps({"kind": "key", "key": "../escape"}),
+            json.dumps({"kind": "key", "key": 7}),
+            json.dumps({"kind": "pool", "servants": []}),
+            "not json at all",
+            json.dumps({"kind": "key", "key": "ytpu-sim-entry-ok2"}),
+        ]
+        path.write_text("\n".join(lines) + "\n")
+        assert load_key_trace(str(path)) == [
+            "ytpu-sim-entry-ok", "ytpu-sim-entry-ok2"]
+        assert load_key_trace(str(path), max_keys=1) == [
+            "ytpu-sim-entry-ok"]
+
+    def test_load_and_warm_front_door(self, tmp_path):
+        svc = _service(tmp_path)
+        try:
+            path = str(tmp_path / "t.jsonl")
+            generate_key_trace(path, keys=8, draws=50, seed=1)
+            for i in range(8):
+                svc.l3.put(f"ytpu-sim-entry-{i:08d}", b"warmed")
+            stats = load_and_warm(svc, path, clock=_FakeClock())
+            assert stats["fetched"] == len(
+                {k for k in load_key_trace(path)})
+        finally:
+            svc.stop()

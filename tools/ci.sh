@@ -186,6 +186,15 @@ if ! python -m yadcc_tpu.tools.cluster_sim --scenario cell-kill --smoke; then
   echo "chaos smoke (cell-kill) FAILED" >&2
   fail=1
 fi
+# Three-level cache tentpole (doc/cache.md "Three levels"): a second
+# region booted EMPTY over the shared L3 bucket must serve a paced key
+# stream with zero errors (read-through promotion off the reply path),
+# and the trace-driven prefetch arm must reach 90% of the warm
+# region's steady hit rate at least 2x faster than the cold arm.
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario cold-region --smoke; then
+  echo "chaos smoke (cold-region) FAILED" >&2
+  fail=1
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -155,3 +155,69 @@ class DeviceBloomReplica:
             self._words_dev, keys, self._salt,
             num_bits=self._host.num_bits,
             num_hashes=self._host.num_hashes)
+
+
+class DeviceBloomCascade:
+    """Device-sharded two-level cascade: region filter (L1/L2 keys) OR
+    fleet filter (shared L3 keys), evaluated in one launch per
+    length-bucket via parallel/mesh.py:sharded_bloom_cascade_fn.
+
+    Both filters must share num_bits (the generators' default geometry
+    guarantees this); salts and hash counts may differ.  Word arrays are
+    re-uploaded per call because the daemon's incremental sync mutates
+    its host filters in place between batches — correctness over upload
+    reuse, same trade the single-filter reader path makes.
+    """
+
+    def __init__(self, mesh=None):
+        from ..parallel import mesh as pmesh
+
+        self._mesh = mesh if mesh is not None else pmesh.make_mesh()
+        # (length, num_hashes_region, num_hashes_fleet) -> jitted fn.
+        self._fns = {}
+        self._num_bits: Optional[int] = None
+
+    def _fn(self, length: int, num_bits: int, nh_region: int,
+            nh_fleet: int):
+        from ..parallel import mesh as pmesh
+
+        key = (length, nh_region, nh_fleet)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = pmesh.sharded_bloom_cascade_fn(
+                self._mesh, length=length, num_bits=num_bits,
+                num_hashes_region=nh_region, num_hashes_fleet=nh_fleet)
+            self._fns[key] = fn
+        return fn
+
+    def may_contain_batch(self, region: "bloom.SaltedBloomFilter",
+                          fleet: "bloom.SaltedBloomFilter",
+                          keys: List[str]):
+        """bool numpy array [len(keys)]: True iff the region OR the
+        fleet filter may contain the key.  Bit-equal to the host
+        reference `region.may_contain_batch(keys) |
+        fleet.may_contain_batch(keys)` (tests/test_bloom_fast.py)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.bloom_pipeline import pack_key_buckets, seed_pair
+        from ..parallel import mesh as pmesh
+
+        if not keys:
+            return np.zeros(0, bool)
+        if region.num_bits != fleet.num_bits:
+            raise ValueError("cascade filters must share num_bits: "
+                             f"{region.num_bits} != {fleet.num_bits}")
+        rw = jnp.asarray(pmesh.bloom_words_padded(
+            region.words, self._mesh, region.num_bits))
+        fw = jnp.asarray(pmesh.bloom_words_padded(
+            fleet.words, self._mesh, fleet.num_bits))
+        rseed = seed_pair(region.salt)
+        fseed = seed_pair(fleet.salt)
+        out = np.zeros(len(keys), bool)
+        for length, rows, packed in pack_key_buckets(keys):
+            fn = self._fn(length, region.num_bits, region.num_hashes,
+                          fleet.num_hashes)
+            verdicts = np.asarray(fn(rw, fw, packed, rseed, fseed))
+            out[rows] = verdicts
+        return out

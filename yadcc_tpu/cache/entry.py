@@ -58,6 +58,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--l1-ttl", type=float, default=4 * 3600.0,
                    help="idle seconds before the 1-min purge timer "
                         "expires an L1 entry (0 disables expiry)")
+    # Shared L3 tier: a bucket this regional server reads through to
+    # and writes back into, shared with peer regions (doc/cache.md
+    # "Three levels").  "s3" reuses the --s3-* connection flags with
+    # its own prefix so one object store can host both tiers.
+    p.add_argument("--l3-engine", default="none",
+                   choices=["none", "objstore", "s3"],
+                   help="shared L3 object-store tier (none = two-level "
+                        "server, the previous behavior)")
+    p.add_argument("--l3-root", default="",
+                   help="objstore L3: shared bucket root directory")
+    p.add_argument("--l3-s3-prefix", default="ytpu-l3/")
+    p.add_argument("--l3-capacity", default="1T")
+    p.add_argument("--l3-workers", type=int, default=2,
+                   help="background pool threads for async L3 "
+                        "promotions and write-backs")
     p.add_argument("--acceptable-user-tokens", default="")
     p.add_argument("--acceptable-servant-tokens", default="")
     p.add_argument("--rpc-frontend", default="threaded",
@@ -106,9 +121,30 @@ def cache_server_start(args) -> None:
         )
     else:
         l2 = make_engine("null")
+    l3 = None
+    if args.l3_engine == "objstore":
+        l3 = make_engine("objstore", root=args.l3_root,
+                         capacity=parse_size(args.l3_capacity))
+    elif args.l3_engine == "s3":
+        import os
+        l3 = make_engine(
+            "s3",
+            endpoint=args.s3_endpoint,
+            bucket=args.s3_bucket,
+            prefix=args.l3_s3_prefix,
+            region=args.s3_region,
+            access_key=args.s3_access_key
+            or os.environ.get("YTPU_S3_ACCESS_KEY", ""),
+            secret_key=args.s3_secret_key
+            or os.environ.get("YTPU_S3_SECRET_KEY", ""),
+            use_tls=args.s3_tls,
+            capacity=parse_size(args.l3_capacity),
+        )
     service = CacheService(
         InMemoryCache(parse_size(args.l1_capacity)),
         l2,
+        l3=l3,
+        l3_workers=args.l3_workers,
         l1_ttl_s=args.l1_ttl or float("inf"),
         user_tokens=make_token_verifier_from_flag(
             args.acceptable_user_tokens),
@@ -149,7 +185,10 @@ def cache_server_start(args) -> None:
             last_purge = time.monotonic()
     server.stop()
     inspect.stop()
+    service.stop()  # drain the async L3 pool before the engines close
     l2.stop()
+    if l3 is not None:
+        l3.stop()
 
 
 def main() -> None:

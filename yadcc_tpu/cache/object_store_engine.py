@@ -57,8 +57,16 @@ class FsObjectStoreBackend(ObjectStoreBackend):
 
     def put(self, name: str, data: bytes) -> None:
         tmp = self._root / f".tmp.{name}.{threading.get_native_id()}"
-        tmp.write_bytes(data)
-        tmp.replace(self._root / name)
+        try:
+            tmp.write_bytes(data)
+            tmp.replace(self._root / name)
+        finally:
+            # A failed write_bytes (disk full) or replace must not
+            # strand the temp file: list_objects filters ".tmp." names,
+            # but a stranded file still eats bucket space forever.
+            # After a successful replace the temp name no longer
+            # exists, so this is a no-op on the happy path.
+            tmp.unlink(missing_ok=True)
 
     def delete(self, name: str) -> None:
         (self._root / name).unlink(missing_ok=True)
@@ -178,6 +186,16 @@ class ObjectStoreEngine(CacheEngine):
             self._resync()
         with self._lock:
             return [_key_of_object(n) for n in self._sizes]
+
+    def contains(self, key: str) -> bool:
+        """Membership against this server's *view* of the bucket — a
+        pure bookkeeping lookup, no backend round trip.  The view is at
+        most resync_interval_s stale, which is exactly the write-back
+        dedup contract: a peer's write this server hasn't listed yet may
+        be re-uploaded once, never forever."""
+        name = _object_name(key)
+        with self._lock:
+            return name in self._sizes
 
     def resync_for_testing(self) -> None:
         self._resync()

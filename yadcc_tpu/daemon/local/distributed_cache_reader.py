@@ -9,6 +9,16 @@ pay a network round trip per TU.
 TPU path: when a batch of keys needs testing at once (burst submits,
 the benchmark sweep), the replica's word array is probed on-device via
 ops/bloom_probe.py — see batch_may_contain().
+
+Cascade: against a cache server with a shared L3 tier, the reader also
+replicates the FLEET filter (keys in the L3 bucket, synced via
+FetchFleetBloomFilter on the same incremental/full protocol) and
+batch_may_contain answers "region OR fleet" in one device-sharded
+launch (parallel/mesh.py:sharded_bloom_cascade_fn) — a key the region
+never served but a peer region uploaded still predicts as a hit, which
+is what makes L3 read-through worth the retry.  Servers without an L3
+answer NOT_FOUND once and the reader permanently falls back to the
+single-filter path.
 """
 
 from __future__ import annotations
@@ -21,6 +31,7 @@ from typing import List, Optional
 from ... import api
 from ...common import bloom, compress
 from ...rpc import Channel, RpcError
+from ...rpc.transport import STATUS_METHOD_NOT_FOUND
 from ...utils.logging import get_logger
 
 logger = get_logger("daemon.cache_reader")
@@ -30,9 +41,11 @@ _SYNC_INTERVAL_S = 10.0
 
 
 class DistributedCacheReader:
-    def __init__(self, cache_server_uri: str, token: str):
+    def __init__(self, cache_server_uri: str, token: str,
+                 use_device_cascade: bool = True):
         self._uri = cache_server_uri
         self._token = token
+        self._use_device_cascade = use_device_cascade
         self._lock = threading.Lock()
         # Learned from each full fetch (rides the payload); paired with
         # _filter — they must only ever be read together under the lock
@@ -43,10 +56,19 @@ class DistributedCacheReader:
             None  # guarded by: self._lock
         self._last_full_fetch = 0.0  # guarded by: self._lock
         self._last_fetch = 0.0  # guarded by: self._lock
+        # Fleet-filter replica (the cascade's L3 level): same pairing
+        # rule as (_salt, _filter) above.
+        self._fleet_salt = 0  # guarded by: self._lock
+        self._fleet_filter: Optional[bloom.SaltedBloomFilter] = \
+            None  # guarded by: self._lock
+        self._fleet_last_full_fetch = 0.0  # guarded by: self._lock
+        self._fleet_last_fetch = 0.0  # guarded by: self._lock
+        self._fleet_unsupported = False  # guarded by: self._lock
         self._full_interval = _FULL_FETCH_INTERVAL_S * random.uniform(0.9, 1.1)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._channel: Optional[Channel] = None  # guarded by: self._lock
+        self._cascade = None  # lazy DeviceBloomCascade; jit-cache holder
         self.hits = 0  # guarded by: self._lock
         self.bloom_rejects = 0  # guarded by: self._lock
         self.misses = 0  # guarded by: self._lock
@@ -76,7 +98,13 @@ class DistributedCacheReader:
             return None
         with self._lock:
             flt = self._filter
-        if flt is not None and not flt.may_contain(key):
+            fleet = self._fleet_filter
+        if (flt is not None and not flt.may_contain(key)
+                and (fleet is None or not fleet.may_contain(key))):
+            # Definite miss in every cascade level the reader knows
+            # about.  A fleet-only maybe still goes to the server: the
+            # entry lives in L3 and the async promote makes the *next*
+            # read a hit even though this one answers NOT_FOUND.
             with self._lock:
                 self.bloom_rejects += 1
             return None
@@ -99,36 +127,71 @@ class DistributedCacheReader:
 
         Rides the fused fingerprint→probe pipeline: the replica's raw
         key bytes go up once and one bool[N] comes back — no host
-        hashing, no [N, 2] fingerprint upload (ops/bloom_pipeline.py)."""
+        hashing, no [N, 2] fingerprint upload (ops/bloom_pipeline.py).
+        With a fleet replica synced, region and fleet filters resolve in
+        ONE cascade launch (region-maybe OR fleet-maybe per key)."""
         import numpy as np
 
-        # Snapshot filter AND salt under one lock hold: a concurrent
-        # full fetch swaps both, and probing new words with the old
-        # salt (or vice versa) yields wrong membership answers — found
-        # by ytpu-analyze (guarded-by) when _salt gained its annotation.
+        # Snapshot filters AND salts under one lock hold: a concurrent
+        # full fetch swaps a (words, salt) pair, and probing new words
+        # with the old salt (or vice versa) yields wrong membership
+        # answers — found by ytpu-analyze (guarded-by) when _salt
+        # gained its annotation.
         with self._lock:
             flt = self._filter
             salt = self._salt
+            fleet = self._fleet_filter
         if flt is None or not keys:
             return np.ones(len(keys), bool)
+        if (fleet is not None and self._use_device_cascade
+                and fleet.num_bits == flt.num_bits):
+            if self._cascade is None:
+                from ...cache.bloom_filter_generator import \
+                    DeviceBloomCascade
+                self._cascade = DeviceBloomCascade()
+            return self._cascade.may_contain_batch(flt, fleet, keys)
         import jax.numpy as jnp
 
         from ...ops.bloom_pipeline import bloom_membership_batch
 
-        return bloom_membership_batch(
+        verdict = bloom_membership_batch(
             jnp.asarray(flt.words), keys, salt,
             num_bits=flt.num_bits, num_hashes=flt.num_hashes)
+        if fleet is not None:
+            # Geometry mismatch (or cascade disabled): two single-filter
+            # launches, host OR — same verdicts, one extra launch.
+            verdict = verdict | bloom_membership_batch(
+                jnp.asarray(fleet.words), keys, fleet.salt,
+                num_bits=fleet.num_bits, num_hashes=fleet.num_hashes)
+        return verdict
 
     # -- sync ----------------------------------------------------------------
 
     def sync_once(self) -> None:
+        self._sync_filter("FetchBloomFilter")
+        with self._lock:
+            skip_fleet = self._fleet_unsupported
+        if not skip_fleet:
+            self._sync_filter("FetchFleetBloomFilter")
+
+    def _sync_filter(self, method: str) -> None:
+        """One sync round for one cascade level.  Region state and fleet
+        state are disjoint (method-selected below) but follow the same
+        incremental/full protocol."""
+        is_fleet = method == "FetchFleetBloomFilter"
         now = time.monotonic()
         with self._lock:
-            since_full = (now - self._last_full_fetch
-                          if self._last_full_fetch else 0)
-            since_any = now - self._last_fetch if self._last_fetch else 0
-            force_full = (self._filter is None
-                          or since_full >= self._full_interval)
+            if is_fleet:
+                last_full = self._fleet_last_full_fetch
+                last_any = self._fleet_last_fetch
+                have = self._fleet_filter is not None
+            else:
+                last_full = self._last_full_fetch
+                last_any = self._last_fetch
+                have = self._filter is not None
+            since_full = now - last_full if last_full else 0
+            since_any = now - last_any if last_any else 0
+            force_full = not have or since_full >= self._full_interval
         req = api.cache.FetchBloomFilterRequest(
             token=self._token,
             seconds_since_last_full_fetch=0 if force_full
@@ -137,26 +200,45 @@ class DistributedCacheReader:
         )
         try:
             resp, att = self._chan().call(
-                "ytpu.CacheService", "FetchBloomFilter", req,
+                "ytpu.CacheService", method, req,
                 api.cache.FetchBloomFilterResponse, timeout=10.0)
         except RpcError as e:
-            logger.warning("bloom sync failed: %s", e)
+            if is_fleet and e.status in (api.cache.CACHE_STATUS_NOT_FOUND,
+                                         STATUS_METHOD_NOT_FOUND):
+                # Server has no L3 tier (or predates the RPC): stop
+                # asking — the single-filter path is the whole story.
+                with self._lock:
+                    self._fleet_unsupported = True
+                logger.info("cache server has no fleet filter; "
+                            "cascade disabled")
+            else:
+                logger.warning("bloom sync (%s) failed: %s", method, e)
             return
         with self._lock:
-            self._last_fetch = now
+            if is_fleet:
+                self._fleet_last_fetch = now
+            else:
+                self._last_fetch = now
             if resp.incremental:
-                if self._filter is not None:
+                target = self._fleet_filter if is_fleet else self._filter
+                if target is not None:
                     # Batched insert: one vectorized fingerprint pass
                     # over the sync window, not a digest call per key.
-                    self._filter.add_many(
-                        list(resp.newly_populated_keys))
+                    target.add_many(list(resp.newly_populated_keys))
             else:
                 data = compress.try_decompress(att)
                 if data is not None and len(data) > 4:
-                    self._salt = int.from_bytes(data[:4], "little")
-                    self._filter = bloom.SaltedBloomFilter.from_bytes(
-                        data[4:], resp.num_hashes, self._salt)
-                    self._last_full_fetch = now
+                    salt = int.from_bytes(data[:4], "little")
+                    new = bloom.SaltedBloomFilter.from_bytes(
+                        data[4:], resp.num_hashes, salt)
+                    if is_fleet:
+                        self._fleet_salt = salt
+                        self._fleet_filter = new
+                        self._fleet_last_full_fetch = now
+                    else:
+                        self._salt = salt
+                        self._filter = new
+                        self._last_full_fetch = now
 
     def _loop(self) -> None:
         while not self._stop.wait(timeout=_SYNC_INTERVAL_S):
@@ -171,5 +253,6 @@ class DistributedCacheReader:
     def inspect(self) -> dict:
         with self._lock:
             return {"synced": self._filter is not None, "hits": self.hits,
+                    "fleet_synced": self._fleet_filter is not None,
                     "bloom_rejects": self.bloom_rejects,
                     "misses": self.misses}

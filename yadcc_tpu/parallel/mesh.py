@@ -475,6 +475,71 @@ def sharded_bloom_membership_fn(mesh: Mesh, *, length: int, num_bits: int,
     return jax.jit(fn)
 
 
+def sharded_bloom_cascade_fn(mesh: Mesh, *, length: int, num_bits: int,
+                             num_hashes_region: int, num_hashes_fleet: int):
+    """Two-level Bloom CASCADE in one device launch: the per-region
+    filter (keys in this region's L1/L2) and the fleet filter (keys in
+    the shared L3 bucket), each filter-sharded exactly like
+    sharded_bloom_membership_fn.  A key may be served if EITHER filter
+    admits it, so the combined verdict is
+
+        AND-over-devices(region slices)  OR  AND-over-devices(fleet slices)
+
+    — the per-filter AND must complete before the OR (OR-then-AND would
+    admit keys where each filter rejects on a different device).  Both
+    filters must share num_bits (both sides of the cascade use the
+    generator's default geometry); salts and hash counts may differ, so
+    each filter probes with its own seed.
+
+    Returns a jitted
+        (region_words_padded, fleet_words_padded, packed_keys,
+         region_seed, fleet_seed) -> bool[N]
+    with word arrays from bloom_words_padded and seeds from
+    ops/bloom_pipeline.seed_pair.
+    """
+    from ..ops.bloom_probe import partitioned_shard_bounds
+    from ..ops.xxh64_jax import xxh64_device
+
+    axes = tuple(mesh.axis_names)
+    n_dev = int(np.prod([mesh.shape[a] for a in axes]))
+    bounds = partitioned_shard_bounds(num_bits, n_dev)
+    per = bounds[1] - bounds[0]
+
+    def slice_ok(words_local, packed, seed, num_hashes):
+        # Same per-slice derivation as sharded_bloom_membership_fn
+        # (keep in lockstep with ops/bloom_probe.py:probe_body).
+        hi, lo = xxh64_device(packed, length, seed)
+        h1 = lo[:, None]
+        h2 = (hi | jnp.uint32(1))[:, None]
+        i = jnp.arange(num_hashes, dtype=jnp.uint32)[None, :]
+        idx = (h1 + i * h2) % jnp.uint32(num_bits)
+        widx = (idx >> 5).astype(jnp.int32)
+        local = widx - device_linear_index(mesh, axes) * per
+        mine = (local >= 0) & (local < per)
+        word = words_local[jnp.clip(local, 0, per - 1)]
+        bit = (word >> (idx & 31)) & jnp.uint32(1)
+        return jnp.all((bit == 1) | ~mine, axis=1)
+
+    def body(region_local, fleet_local, packed, seed_region, seed_fleet):
+        vr = slice_ok(region_local, packed, seed_region,
+                      num_hashes_region).astype(jnp.int32)
+        vf = slice_ok(fleet_local, packed, seed_fleet,
+                      num_hashes_fleet).astype(jnp.int32)
+        for name in reversed(axes):      # per-filter AND first (pmin)
+            vr = jax.lax.pmin(vr, name)
+            vf = jax.lax.pmin(vf, name)
+        return jnp.maximum(vr, vf) > 0   # ...then OR across filters
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
 def pad_to_multiple(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 

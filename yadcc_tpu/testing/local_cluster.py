@@ -154,6 +154,7 @@ class LocalCluster:
         servant_concurrency: int = 4,
         compiler_dirs: Optional[List[str]] = None,
         l2_engine: Optional[CacheEngine] = None,
+        l3_engine: Optional[CacheEngine] = None,
         http_port: int = 0,
         admission_config=None,
         # "aio" boots every control-plane server (scheduler, cache,
@@ -194,7 +195,8 @@ class LocalCluster:
         self.cache_service = CacheService(
             InMemoryCache(64 << 20),
             l2_engine if l2_engine is not None else DiskCacheEngine(
-                [ShardSpec(str(tmp / "l2"), 1 << 30)]))
+                [ShardSpec(str(tmp / "l2"), 1 << 30)]),
+            l3=l3_engine)
         self.cache_server = make_rpc_server(self.rpc_frontend,
                                             "127.0.0.1:0",
                                             accept_loops=accept_loops)
@@ -298,4 +300,5 @@ class LocalCluster:
             servant.stop()
         for s in (self.cache_server, self.sched_server):
             s.stop(grace=0)
+        self.cache_service.stop()  # joins the async L3 pool, if any
         self.sched_dispatcher.stop()

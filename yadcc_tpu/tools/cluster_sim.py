@@ -1616,7 +1616,7 @@ def main() -> int:
                          "instead of the friendly sweep: one of "
                          "wan-jitter, burst, flaky-servant, slow-loris, "
                          "oversized-tu, cache-restart, overload-ladder, "
-                         "aot-storm "
+                         "aot-storm, cell-kill, cold-region "
                          "(tools/scenarios.py, doc/robustness.md); "
                          "exits 1 on any SLO miss")
     ap.add_argument("--out", default="",

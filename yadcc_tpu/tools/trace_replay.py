@@ -79,6 +79,59 @@ def _load(path: str) -> List[dict]:
         return [json.loads(line) for line in fp if line.strip()]
 
 
+# -- cache key histories (the prefetch trace; cache/prefetcher.py) ---------
+#
+# A key trace is the same JSONL discipline as the dispatch trace, one
+# event kind: {"kind": "key", "key": "ytpu-..."}.  Production daemons
+# would append one line per cache lookup; here generate_key_trace
+# synthesizes "yesterday" with the Zipf-ish popularity skew real build
+# key streams show (a small hot set dominates).
+
+_MAX_TRACE_KEYS = 1_000_000
+
+
+def generate_key_trace(path: str, *, keys: int = 1000, draws: int = 10000,
+                       zipf_a: float = 1.3, seed: int = 0,
+                       prefix: str = "ytpu-sim-entry-") -> List[str]:
+    """Write a synthetic key-stream trace; returns the key universe.
+    Popularity is Zipf(zipf_a) over the universe so the replayed stream
+    has the hot-set structure prefetch exploits."""
+    rng = np.random.default_rng(seed)
+    universe = [f"{prefix}{i:08d}" for i in range(keys)]
+    ranks = rng.zipf(zipf_a, size=draws)
+    with open(path, "w") as fp:
+        for r in ranks:
+            key = universe[int(r - 1) % keys]
+            fp.write(json.dumps({"kind": "key", "key": key}) + "\n")
+    return universe
+
+
+def load_key_trace(path: str, max_keys: int = _MAX_TRACE_KEYS) -> List[str]:
+    """Key stream from a trace file, in recorded order.  Replayed input:
+    every key passes the prefetcher's key-domain sanitizer and the count
+    is capped — a corrupt or hostile trace degrades to fewer keys, never
+    to arbitrary object names or an unbounded list."""
+    from ..cache.prefetcher import sanitize_prefetch_key
+
+    out: List[str] = []
+    with open(path) as fp:
+        for line in fp:
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if ev.get("kind") != "key":
+                continue
+            key = sanitize_prefetch_key(ev.get("key"))
+            if key is not None:
+                out.append(key)
+            if len(out) >= max_keys:
+                break
+    return out
+
+
 def _snapshot_from_pool(pool_ev: dict) -> PoolSnapshot:
     servants = pool_ev["servants"]
     s = len(servants)
