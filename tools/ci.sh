@@ -36,6 +36,17 @@ if [ "$lint_secs" -gt 120 ]; then
   echo "lint stage exceeded its 120s budget (${lint_secs}s)" >&2
   fail=1
 fi
+# Exactly-once replication gate, dynamic half (doc/static_analysis.md
+# "Replication / exactly-once protocol"): the deterministic
+# interleaving explorer sweeps preemption-bounded schedules of the real
+# issue/renew/free and ship-vs-takeover paths — every schedule must
+# hold the journal/registry invariants, AND the canary mutants
+# (dropped journal lock, skipped adoption window) must be CAUGHT, so a
+# green run also proves the explorer still has teeth.
+if ! python -m yadcc_tpu.testing.interleave --smoke; then
+  echo "interleave smoke FAILED" >&2
+  fail=1
+fi
 # Wire-format golden gates: the committed gen modules for the
 # pure-maintained protos must be byte-identical to what --pure emits
 # (descriptor drift fails before it ships), and the analyzer above

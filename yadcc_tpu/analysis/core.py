@@ -92,6 +92,23 @@ RULES: Dict[str, str] = {
     "async-task-orphan": "asyncio task neither awaited, cancelled, "
                          "retained nor handed off (orphaned tasks "
                          "silently eat exceptions)",
+    "repl-journal-skip": "a mutation path of a # ytpu: replicated(...) "
+                         "method commits to the wrapped dispatcher "
+                         "without a post-commit journal append (or "
+                         "appends before the commit / on an exception "
+                         "path)",
+    "repl-journal-under-lock": "lease-journal append while a lock is "
+                               "held: the rank-4 leaf journal must only "
+                               "be taken at the call boundary, never "
+                               "nested under dispatcher state locks",
+    "grant-id-arith": "bare arithmetic on a grant id outside the "
+                      "blessed namespace helpers, or a (start, stride) "
+                      "construction that breaks the cell x shard "
+                      "stride composition",
+    "takeover-order": "a # ytpu: protocol(a<b<...) step reached on a "
+                      "path where an earlier declared step has not "
+                      "happened (e.g. promote before the adoption "
+                      "window is established)",
     "suppression": "malformed suppression or suppression without a "
                    "written reason",
     "parse-error": "file could not be parsed",
@@ -139,6 +156,18 @@ _UNTRUSTED_RE = re.compile(
 _RESPONDER_RE = re.compile(
     r"#\s*ytpu:\s*responder\(\s*([A-Za-z0-9_,\s]*)\s*\)")
 _LOOP_ONLY_RE = re.compile(r"#\s*ytpu:\s*loop-only\b")
+# Replication-protocol directives (replproto family).  Both ride the
+# def line like the trust-boundary directives:
+#
+#   def free_task(self, loc, gids):  # ytpu: replicated(free)
+#     -> every mutation path of this method must pair the commit with a
+#        post-commit journal append carrying one of the declared ops.
+#   def takeover(self):  # ytpu: protocol(freeze<replay<adopt<window<promote)
+#     -> declared step order; every path must hit steps in order.
+_REPLICATED_RE = re.compile(
+    r"#\s*ytpu:\s*replicated\(\s*([A-Za-z0-9_,\s]*)\s*\)")
+_PROTOCOL_RE = re.compile(
+    r"#\s*ytpu:\s*protocol\(\s*([A-Za-z0-9_<\s]*)\s*\)")
 
 
 @dataclass
@@ -197,9 +226,19 @@ class AnalyzerConfig:
     # Path fragments (filename parts) selecting the dispatcher-cycle
     # modules where device-sync applies: the device-resident dispatch
     # hot loop, where any unsanctioned np.asarray/block_until_ready
-    # stalls the fused launch pipeline.
+    # stalls the fused launch pipeline.  federation.py / replication.py
+    # ride along (ISSUE 18): cell routing and journal replay sit on the
+    # same cycle and must not host-sync either.
     device_sync_path_fragments: Tuple[str, ...] = (
         "device_pool.py", "shard_router.py", "policy.py",
+        "task_dispatcher.py", "federation.py", "replication.py")
+    # Path fragments (filename parts) selecting the modules where the
+    # replication / exactly-once family (repl-journal-skip,
+    # repl-journal-under-lock, grant-id-arith, takeover-order) applies.
+    # Any file carrying a replicated(...)/protocol(...) directive is
+    # in scope regardless of name.
+    replproto_path_fragments: Tuple[str, ...] = (
+        "replication.py", "federation.py", "shard_router.py",
         "task_dispatcher.py")
     # Path fragments selecting the modules where the async-protocol
     # family (reply-once / await-under-lock / loop-affinity /
@@ -226,6 +265,7 @@ class AnalyzerConfig:
                 "aio": list(self.aio_path_fragments),
                 "dsync": list(self.device_sync_path_fragments),
                 "asyncproto": list(self.asyncproto_path_fragments),
+                "replproto": list(self.replproto_path_fragments),
                 "ranks": dict(self.lock_ranks)}
 
 
@@ -250,6 +290,8 @@ class Directives:
         self.untrusted: Dict[int, List[str]] = {}  # lineno -> param specs
         self.responders: Dict[int, List[str]] = {}  # lineno -> param names
         self.loop_only: Set[int] = set()           # lineno set
+        self.replicated: Dict[int, List[str]] = {}  # lineno -> journal ops
+        self.protocol: Dict[int, List[str]] = {}   # lineno -> ordered steps
         for lineno, text in enumerate(source.splitlines(), start=1):
             if "#" not in text:
                 continue
@@ -285,6 +327,16 @@ class Directives:
                                            if t.strip()]
             if _LOOP_ONLY_RE.search(text):
                 self.loop_only.add(lineno)
+            rp = _REPLICATED_RE.search(text)
+            if rp:
+                self.replicated[lineno] = [t.strip()
+                                           for t in rp.group(1).split(",")
+                                           if t.strip()]
+            pr = _PROTOCOL_RE.search(text)
+            if pr:
+                self.protocol[lineno] = [t.strip()
+                                         for t in pr.group(1).split("<")
+                                         if t.strip()]
 
     def suppression_for(self, line: int, rule: str) -> Optional[Suppression]:
         s = self.suppressions.get(line)
@@ -480,6 +532,8 @@ class FunctionInfo:
     untrusted: List[str] = field(default_factory=list)
     responders: List[str] = field(default_factory=list)
     loop_only: bool = False
+    replicated: List[str] = field(default_factory=list)  # journal ops
+    protocol: List[str] = field(default_factory=list)    # ordered steps
     # Filled by the taint summary pass (taint.summarize_function);
     # JSON-serializable so the result cache can persist it.
     taint: Optional[dict] = None
@@ -497,6 +551,8 @@ class FunctionInfo:
                 "untrusted": list(self.untrusted),
                 "responders": list(self.responders),
                 "loop_only": self.loop_only,
+                "replicated": list(self.replicated),
+                "protocol": list(self.protocol),
                 "taint": self.taint,
                 "asyncp": self.asyncp}
 
@@ -510,6 +566,8 @@ class FunctionInfo:
                    untrusted=list(d.get("untrusted", ())),
                    responders=list(d.get("responders", ())),
                    loop_only=bool(d.get("loop_only", False)),
+                   replicated=list(d.get("replicated", ())),
+                   protocol=list(d.get("protocol", ())),
                    taint=d.get("taint"),
                    asyncp=d.get("asyncp"))
 
@@ -563,6 +621,12 @@ def collect_functions(model: ModuleModel) -> List[FunctionInfo]:
                             if s not in info.responders)
                     if ln in d.loop_only:
                         info.loop_only = True
+                    if ln in d.replicated:
+                        info.replicated.extend(
+                            s for s in d.replicated[ln]
+                            if s not in info.replicated)
+                    if ln in d.protocol and not info.protocol:
+                        info.protocol = list(d.protocol[ln])
                 out.append(info)
                 visit(child, qual, cls)
             elif isinstance(child, ast.ClassDef):
@@ -856,7 +920,9 @@ def scan_directives(sources: Dict[str, str]
             for regex, kind in ((_SANITIZES_RE, "sanitizes"),
                                 (_ACQUIRES_RE, "acquires"),
                                 (_UNTRUSTED_RE, "untrusted"),
-                                (_RESPONDER_RE, "responder")):
+                                (_RESPONDER_RE, "responder"),
+                                (_REPLICATED_RE, "replicated"),
+                                (_PROTOCOL_RE, "protocol")):
                 m = regex.search(text)
                 if m:
                     hit = (kind, m.group(1))
@@ -917,7 +983,7 @@ def analyze_paths(paths: Sequence[str],
     import time as _time
 
     from . import (asyncproto, device_sync, jit_hygiene, lifecycle,
-                   lockrules, taint, wirecompat)
+                   lockrules, replproto, taint, wirecompat)
 
     config = config or AnalyzerConfig()
     files = _collect_py_files(paths)
@@ -951,6 +1017,14 @@ def analyze_paths(paths: Sequence[str],
         (directive_fp + cfg_fp).encode()).hexdigest()
 
     # -- phase 1: per-file analysis (cache-keyed on content + globals) -----
+    # Cache lookups, parsing and the mutating summary passes stay
+    # serial (parse errors land deterministically and the summaries
+    # write into the shared FunctionInfo records); the read-only rule
+    # families then fan out on a thread pool, ONE WORKER PER FAMILY,
+    # each sweeping every cold file.  The content-hash cache is
+    # unchanged: a cache hit removes the file from every family's
+    # sweep, and per-family wall times land in stats["timings"].
+    cold: List[_FileRecord] = []
     for rel, path in files:
         if rel not in sources:
             continue
@@ -981,18 +1055,43 @@ def analyze_paths(paths: Sequence[str],
             _timed("asyncproto", asyncproto.summarize_functions,
                    rec.model, rec.functions)
             rec.callsites = _collect_callsites(rec.model)
-            raw: List[Finding] = []
-            raw.extend(_timed("lockrules", lockrules.check_module,
-                              rec.model, config))
-            raw.extend(_timed("jit-hygiene", jit_hygiene.check_module,
-                              rec.model, config))
-            raw.extend(_timed("device-sync", device_sync.check_module,
-                              rec.model, config))
-            raw.extend(_timed("lifecycle", lifecycle.check_module,
-                              rec.model, config, acquires_names))
-            raw.extend(_timed("asyncproto", asyncproto.check_module,
-                              rec.model, rec.functions, config,
-                              loop_only_names))
+            cold.append(rec)
+        records.append(rec)
+
+    families = (
+        ("lockrules",
+         lambda r: lockrules.check_module(r.model, config)),
+        ("jit-hygiene",
+         lambda r: jit_hygiene.check_module(r.model, config)),
+        ("device-sync",
+         lambda r: device_sync.check_module(r.model, config)),
+        ("lifecycle",
+         lambda r: lifecycle.check_module(r.model, config,
+                                          acquires_names)),
+        ("asyncproto",
+         lambda r: asyncproto.check_module(r.model, r.functions, config,
+                                           loop_only_names)),
+        ("replproto",
+         lambda r: replproto.check_module(r.model, r.functions, config)),
+    )
+
+    def _family_sweep(name, fn):
+        f0 = _time.perf_counter()
+        out = {rec.relpath: fn(rec) for rec in cold}
+        return name, out, _time.perf_counter() - f0
+
+    if cold:
+        from concurrent.futures import ThreadPoolExecutor
+        workers = min(len(families), max(2, os.cpu_count() or 2))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            swept = list(pool.map(lambda nf: _family_sweep(*nf),
+                                  families))
+        for name, _, secs in swept:
+            timings[name] = timings.get(name, 0.0) + secs
+        by_family = {name: out for name, out, _ in swept}
+        for rec in cold:
+            raw = [f for name, _ in families
+                   for f in by_family[name].get(rec.relpath, ())]
             rec.local_findings = raw
             if cache is not None:
                 cache.put(rec.content_hash, global_key, {
@@ -1002,7 +1101,6 @@ def analyze_paths(paths: Sequence[str],
                                   "line": f.line, "message": f.message}
                                  for f in raw],
                 })
-        records.append(rec)
     timings["per-file-total"] = _time.perf_counter() - t0
 
     all_functions: List[FunctionInfo] = []

@@ -551,10 +551,15 @@ class TaskDispatcher:
         After the window closes, unknown ids go back to being killed —
         the PR 6 restart-no-double-run contract."""
         with self._lock:
-            ceiling = (int(floor_grant_id)
+            ceiling = (int(floor_grant_id)  # ytpu: allow(grant-id-arith)  # the gap-slack ceiling IS namespace math: floor + slack whole strides stays on this dispatcher's residue
                        + max(0, gap_slack) * self._grant_id_stride)
             self._adopt_floor = max(self._adopt_floor, ceiling)
-            self._adopt_until = self._clock.now() + max(0.0, grace_s)
+            # max(): adopt_grants may already have parked entries whose
+            # lease extends past grace_s; a later window-open must never
+            # SHRINK the deadline under them or the purge at the window
+            # close kills work the journal proved was running.
+            self._adopt_until = max(self._adopt_until,
+                                    self._clock.now() + max(0.0, grace_s))
             self._advance_grant_id_locked(self._adopt_floor)
 
     def _adoptable_locked(self, gid: int, now: float) -> bool:
@@ -1130,7 +1135,7 @@ class TaskDispatcher:
             expires_at=now + req.lease_s,
             requestor=req.requestor,
         )
-        self._next_grant_id += self._grant_id_stride
+        self._next_grant_id += self._grant_id_stride  # ytpu: allow(grant-id-arith)  # THE mint site: stepping by the namespace stride is the one sanctioned id arithmetic outside the helpers
         self._grants[g.grant_id] = g
         servant.running_grants.add(g.grant_id)
         self._arr_running[pick] += 1
