@@ -514,8 +514,27 @@ def main() -> None:
     except Exception:
         coldregion = {}
 
+    # Scored-spillover canaries (tools/scenarios.py spill-affinity
+    # smoke, doc/benchmarks.md "Scored spillover placement"): the
+    # scored arm's post-spill cache hit rate and the p99 cost of one
+    # scored placement decision, device launch included.
+    try:
+        from yadcc_tpu.tools.scenarios import quick_spill_affinity_metrics
+
+        spill_affinity = quick_spill_affinity_metrics()
+    except Exception:
+        spill_affinity = {}
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 14 (r19+): adds `placement_warm_hit_rate` (post-spill
+        # cache hit rate of the scored-placement arm in a smoke
+        # spill-affinity run — spills landing on the warm peer despite
+        # its higher load) and `placement_score_p99_us` (p99 of one
+        # scored spill decision through the fused cells x tasks device
+        # launch, signal reads and readback included; tools/scenarios.py
+        # spill-affinity, doc/benchmarks.md "Scored spillover
+        # placement").  Every v13 field is still emitted.
         # Version 13 (r18+): adds `l3_read_through_hit_rate` (final hit
         # rate of the prefetch-OFF cold-region arm — a region with
         # empty L1/L2 warming purely via the shared L3 bucket's async
@@ -586,7 +605,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 13,
+        "harness_version": 14,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -643,6 +662,10 @@ def main() -> None:
             "l3_read_through_hit_rate"),
         "prefetch_time_to_warm_s": coldregion.get(
             "prefetch_time_to_warm_s"),
+        "placement_warm_hit_rate": spill_affinity.get(
+            "placement_warm_hit_rate"),
+        "placement_score_p99_us": spill_affinity.get(
+            "placement_score_p99_us"),
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

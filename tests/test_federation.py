@@ -23,6 +23,7 @@ import time
 import pytest
 
 from yadcc_tpu import api
+from yadcc_tpu.common import bloom
 from yadcc_tpu.rpc import (Channel, RpcError, ServiceSpec,
                            install_fault_injector, register_mock_server,
                            retry_after_ms_from_error,
@@ -462,6 +463,133 @@ class TestSpillover:
     def test_parked_submit_api_is_hidden(self, plane):
         _, _, routers = plane
         assert not hasattr(routers[0], "submit_wait_for_starting_new_task")
+
+
+# --------------------------------------------------------------------------
+# Scored spill placement: warmth + load + topology in one launch
+# (doc/scheduler.md "Federation", scheduler/placement.py).
+# --------------------------------------------------------------------------
+
+SPILL_KEYS = [f"spillkey-{i:02d}" for i in range(12)]
+
+
+def _region_filter(keys, salt=777):
+    f = bloom.SaltedBloomFilter(num_bits=1 << 15, num_hashes=7, salt=salt)
+    if keys:
+        f.add_many(list(keys))
+    return f
+
+
+class TestScoredSpillover:
+    @pytest.fixture
+    def plane3(self):
+        clock = VirtualClock(100.0)
+        ds = [make_dispatcher(cell=c, n_cells=3) for c in range(3)]
+        handles = [CellHandle(c, ds[c]) for c in range(3)]
+        router = FederationRouter(handles, 0, clock=clock)
+        for c, d in enumerate(ds):
+            d.keep_servant_alive(make_servant(f"10.0.{c}.1:1"), 10)
+        yield ds, router, clock
+        for d in ds:
+            d.stop()
+
+    def test_scored_spill_prefers_warm_busier_peer(self, plane3):
+        ds, router, _ = plane3
+        keys = SPILL_KEYS[:8]
+        router.note_candidate_keys(ENV, keys)
+        # Cell 1: warm for every candidate key, but half occupied.
+        # Cell 2: verifiably cold (installed-but-empty filter), idle.
+        # Least-loaded would pick 2; the affinity score must pick 1.
+        router.update_cell_filter(1, _region_filter(keys, salt=11))
+        router.update_cell_filter(2, _region_filter([], salt=22))
+        held = ds[1].wait_for_starting_new_task(ENV, immediate=2,
+                                                timeout_s=1.0)
+        assert len(held) == 2
+        ds[0].restore_admission_rung(RUNG_SPILLOVER)
+        routed = router.wait_for_starting_new_task_routed(
+            ENV, timeout_s=1.0)
+        assert routed.grants and routed.grants[0].spilled
+        assert routed.grants[0].cell_id == 1
+        stats = router.stats()
+        assert stats["placement_scored"] == 1
+        assert stats["placement_fallback_least_loaded"] == 0
+        assert stats["spilled_grants_by_peer"] == {1: 1}
+
+    def test_no_warmth_data_falls_back_least_loaded(self, plane3):
+        ds, router, _ = plane3
+        # Keys noted but NO peer filter installed: the scored rung has
+        # no warmth signal, so the ladder degrades to least-loaded —
+        # cell 2 (idle) over cell 1 (half occupied).
+        router.note_candidate_keys(ENV, SPILL_KEYS[:4])
+        held = ds[1].wait_for_starting_new_task(ENV, immediate=2,
+                                                timeout_s=1.0)
+        assert len(held) == 2
+        ds[0].restore_admission_rung(RUNG_SPILLOVER)
+        routed = router.wait_for_starting_new_task_routed(
+            ENV, timeout_s=1.0)
+        assert routed.grants and routed.grants[0].spilled
+        assert routed.grants[0].cell_id == 2
+        stats = router.stats()
+        assert stats["placement_scored"] == 0
+        assert stats["placement_fallback_least_loaded"] == 1
+        assert stats["spilled_grants_by_peer"] == {2: 1}
+
+    def test_signal_cache_ttl_window(self, plane3):
+        ds, router, clock = plane3
+        ds[0].restore_admission_rung(RUNG_SPILLOVER)
+
+        def spill_once():
+            routed = router.wait_for_starting_new_task_routed(
+                ENV, timeout_s=1.0)
+            assert routed.grants
+            router.free_task([g.grant_id for g in routed.grants])
+
+        spill_once()                    # cold cache: one read per peer
+        assert router.stats()["signal_refreshes"] == 2
+        spill_once()                    # inside the TTL: pure cache
+        stats = router.stats()
+        assert stats["signal_refreshes"] == 2
+        assert stats["signal_cache_hits"] >= 2
+        clock.advance(0.2)              # past the ~100ms TTL
+        spill_once()
+        assert router.stats()["signal_refreshes"] == 4
+
+    def test_inspect_surfaces_federation_block(self, plane3):
+        ds, router, _ = plane3
+        ds[0].restore_admission_rung(RUNG_SPILLOVER)
+        routed = router.wait_for_starting_new_task_routed(
+            ENV, timeout_s=1.0)
+        assert routed.grants
+        fed = router.inspect()["federation"]
+        assert fed["cell_id"] == 0 and fed["n_cells"] == 3
+        assert fed["stats"]["spilled_grants"] == 1
+        placement = fed["latency_breakdown"]["placement"]
+        assert placement["count"] >= 1
+        assert placement["p99_ms"] >= 0.0
+
+
+class TestScoredCellHoming:
+    def test_keyless_clients_keep_consistent_hash(self):
+        d = CellDirectory(["mock://a", "mock://b", "mock://c"])
+        for digest in ("env-a", "env-b", "env-c"):
+            want = d.home_cell(digest)
+            assert d.home_cell_scored(digest) == want
+            assert d.home_cell_scored(digest, keys=["k1"]) == want
+            assert d.home_cell_scored(
+                digest, keys=["k1"], filters=[None, None, None]) == want
+
+    def test_warm_cell_wins_when_filters_known(self):
+        keys = [f"homekey-{i}" for i in range(6)]
+        warm = _region_filter(keys, salt=5)
+        d = CellDirectory(["mock://a", "mock://b"])
+        assert d.home_cell_scored("any-env", keys=keys,
+                                  filters=[None, warm]) == 1
+        assert d.home_cell_scored("any-env", keys=keys,
+                                  filters=[warm, None]) == 0
+        # Equal warmth ties back to the lowest cell, regardless of
+        # where the consistent hash would have landed.
+        assert d.home_cell_scored("any-env", keys=keys,
+                                  filters=[warm, warm]) == 0
 
 
 # --------------------------------------------------------------------------

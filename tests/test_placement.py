@@ -1,0 +1,225 @@
+"""Scored spill placement: host-vs-device parity oracle
+(doc/scheduler.md "Federation", scheduler/placement.py).
+
+The contract under test is BIT-EXACTNESS: `DevicePlacementScorer` (one
+fused launch, in-kernel argmin) and `host_reference_placement` (pure
+int32 numpy) must agree on every score, every pick, and every
+tie-break — including deliberate score ties, which both sides must
+resolve to the LOWEST cell index, and mixed-byte-length key batches,
+where both sides must sample the same dominant length class.  Any
+drift here means the production scorer is no longer auditable against
+the oracle, so these tests are tier-1 (and the CI lint/scenario gates
+ride on them being green).
+"""
+
+import numpy as np
+import pytest
+
+from yadcc_tpu.common import bloom
+from yadcc_tpu.parallel import mesh as pmesh
+from yadcc_tpu.scheduler.placement import (BIG, WARM_SCALE, W_LOAD, W_WARM,
+                                           CellCandidate,
+                                           DevicePlacementScorer,
+                                           host_reference_placement,
+                                           prepare_probe_batch,
+                                           quantize_utilization,
+                                           reference_scores)
+
+
+def _filter_with(keys, *, salt, num_bits=1 << 15, num_hashes=7):
+    f = bloom.SaltedBloomFilter(num_bits=num_bits, num_hashes=num_hashes,
+                                salt=salt)
+    if keys:
+        f.add_many(list(keys))
+    return f
+
+
+# --------------------------------------------------------------------------
+# The host oracle's arithmetic, pinned in isolation.
+# --------------------------------------------------------------------------
+
+
+class TestReferenceScores:
+    def test_warmth_beats_moderate_load(self):
+        # Cell 0: fully warm but busier.  Cell 1: cold but idle.  The
+        # W_WARM=4 weighting must let warmth win any utilization gap
+        # under 4x (the policy doc/scheduler.md documents).
+        hits = np.array([[4], [0]], np.int32)
+        counts = np.array([4], np.int32)
+        util_q = np.array([quantize_utilization(2.0),
+                           quantize_utilization(0.0)], np.int32)
+        zeros = np.zeros(2, np.int32)
+        ones = np.ones(2, np.int32)
+        score, best_cell, best_score = reference_scores(
+            hits, counts, util_q, zeros, ones, ones)
+        assert best_cell[0] == 0
+        assert score[0, 0] == W_LOAD * quantize_utilization(2.0)
+        assert score[1, 0] == W_WARM * WARM_SCALE
+        assert best_score[0] == score[0, 0]
+
+    def test_no_filter_scores_as_fully_cold(self):
+        # has_filter == 0 pins miss_q to WARM_SCALE no matter what the
+        # (meaningless) hits row says.
+        hits = np.array([[4], [4]], np.int32)
+        counts = np.array([4], np.int32)
+        zeros = np.zeros(2, np.int32)
+        ones = np.ones(2, np.int32)
+        has_filter = np.array([1, 0], np.int32)
+        score, best_cell, _ = reference_scores(
+            hits, counts, zeros, zeros, ones, has_filter)
+        assert score[0, 0] == 0
+        assert score[1, 0] == W_WARM * WARM_SCALE
+        assert best_cell[0] == 0
+
+    def test_ineligible_cells_pin_to_big(self):
+        hits = np.array([[4], [0]], np.int32)
+        counts = np.array([4], np.int32)
+        zeros = np.zeros(2, np.int32)
+        ones = np.ones(2, np.int32)
+        eligible = np.array([0, 1], np.int32)
+        score, best_cell, best_score = reference_scores(
+            hits, counts, zeros, zeros, eligible, ones)
+        assert score[0, 0] == BIG
+        assert best_cell[0] == 1
+        # Everyone ineligible => best_score saturates at BIG, the
+        # "walk down the fallback ladder" signal.
+        _, _, bs = reference_scores(hits, counts, zeros, zeros,
+                                    np.zeros(2, np.int32), ones)
+        assert bs[0] == BIG
+
+    def test_tie_breaks_to_lowest_cell(self):
+        hits = np.zeros((3, 2), np.int32)
+        counts = np.array([2, 2], np.int32)
+        zeros = np.zeros(3, np.int32)
+        ones = np.ones(3, np.int32)
+        _, best_cell, _ = reference_scores(
+            hits, counts, zeros, zeros, ones, ones)
+        assert (best_cell == 0).all()
+
+
+class TestProbeBatch:
+    def test_empty_returns_none(self):
+        assert prepare_probe_batch([[], []]) is None
+        assert prepare_probe_batch([]) is None
+
+    def test_dominant_length_class_kept_and_dropped_counted(self):
+        # 5 eight-byte keys vs 2 four-byte stragglers: the dominant
+        # class survives, the stragglers only soften the sample.
+        keys = [["k" * 8, "a" * 8, "zz" * 2], ["b" * 8, "c" * 8, "d" * 4],
+                ["e" * 8]]
+        batch = prepare_probe_batch(keys)
+        assert batch is not None
+        assert batch.length == 8
+        assert batch.dropped == 2
+        assert batch.packed.shape[0] == 5
+        assert list(batch.counts) == [2, 2, 1]
+        assert batch.kept == [["k" * 8, "a" * 8], ["b" * 8, "c" * 8],
+                              ["e" * 8]]
+        assert [int(t) for t in batch.task_of_key] == [0, 0, 1, 1, 2]
+
+
+# --------------------------------------------------------------------------
+# Host vs device: bit-exact, on the real 8-virtual-device mesh.
+# --------------------------------------------------------------------------
+
+
+def _assert_bit_equal(host, dev):
+    assert dev is not None and host is not None
+    assert dev.device and not host.device
+    assert dev.batch.length == host.batch.length
+    assert dev.batch.dropped == host.batch.dropped
+    assert np.array_equal(dev.scores, host.scores), \
+        (dev.scores, host.scores)
+    assert np.array_equal(dev.best_cell, host.best_cell)
+    assert np.array_equal(dev.best_score, host.best_score)
+
+
+class TestHostDeviceParity:
+    @pytest.fixture(scope="class")
+    def scorer(self):
+        return DevicePlacementScorer(pmesh.make_mesh(8))
+
+    def test_seeded_matrix_parity(self, scorer):
+        # 5 cells x 3 tasks, seeded warm/cold split, differing salts,
+        # one ineligible cell, one filterless cell, non-trivial load
+        # and topology terms.  Every score must match bit-for-bit.
+        rng = np.random.default_rng(7)
+        universe = [f"obj-{i:04d}" for i in range(64)]
+        warm_sets = [set(rng.choice(64, size=20, replace=False))
+                     for _ in range(4)]
+        cells = []
+        for ci in range(5):
+            filt = None
+            if ci < 4:
+                filt = _filter_with(
+                    [universe[i] for i in warm_sets[ci]], salt=100 + ci)
+            cells.append(CellCandidate(
+                cell_id=ci,
+                utilization=float(rng.uniform(0.0, 3.0)),
+                topo_distance=int(rng.integers(0, 5)),
+                eligible=(ci != 2),
+                filter=filt))
+        keys_per_task = [
+            [universe[i] for i in rng.choice(64, size=6, replace=False)]
+            for _ in range(3)]
+        host = host_reference_placement(cells, keys_per_task)
+        dev = scorer.score(cells, keys_per_task)
+        _assert_bit_equal(host, dev)
+        # The ineligible cell can never win.
+        assert (dev.best_cell != 2).all()
+
+    def test_tie_resolves_to_lowest_cell_on_both_chains(self, scorer):
+        # Two cells with IDENTICAL filter contents, salt, load and
+        # topology — every score ties, and both chains must pick cell
+        # index 0 (np.argmin first-occurrence == the kernel's argmin).
+        keys = [f"tiekey-{i}" for i in range(8)]
+        cells = [CellCandidate(cell_id=ci,
+                               filter=_filter_with(keys[:4], salt=42))
+                 for ci in range(2)]
+        host = host_reference_placement(cells, [keys])
+        dev = scorer.score(cells, [keys])
+        _assert_bit_equal(host, dev)
+        assert np.array_equal(dev.scores[0], dev.scores[1])
+        assert (dev.best_cell == 0).all()
+
+    def test_mixed_length_batch_parity(self, scorer):
+        # Host and device must sample the SAME dominant length class
+        # and agree on what was dropped.
+        cells = [
+            CellCandidate(cell_id=0,
+                          filter=_filter_with(["warm-a-1", "warm-a-2"],
+                                              salt=1)),
+            CellCandidate(cell_id=1, utilization=0.5,
+                          filter=_filter_with([], salt=2)),
+        ]
+        keys_per_task = [["warm-a-1", "warm-a-2", "sh"],
+                         ["cold-b-1", "xy"]]
+        host = host_reference_placement(cells, keys_per_task)
+        dev = scorer.score(cells, keys_per_task)
+        _assert_bit_equal(host, dev)
+        assert dev.batch.dropped == 2
+        assert dev.best_cell[0] == 0       # warm for task 0's keys
+        # Task 1 is cold on both cells, so the load term decides: the
+        # idle cell 0 beats cell 1 at util 0.5.
+        assert dev.best_cell[1] == 0
+        assert dev.best_cell[1] == int(np.argmin(dev.scores[:, 1]))
+
+    def test_device_declines_without_warmth_signal(self, scorer):
+        # No keys, or no filter anywhere -> None: the scored path has
+        # nothing to add over least-loaded, callers take the ladder.
+        cells = [CellCandidate(cell_id=0), CellCandidate(cell_id=1)]
+        assert scorer.score(cells, [["k1", "k2"]]) is None
+        assert scorer.score(
+            [CellCandidate(cell_id=0, filter=_filter_with([], salt=3))],
+            [[]]) is None
+        assert host_reference_placement(cells, [[]]) is None
+
+    def test_filter_geometry_mismatch_is_an_error(self, scorer):
+        cells = [
+            CellCandidate(cell_id=0, filter=_filter_with([], salt=1)),
+            CellCandidate(cell_id=1,
+                          filter=_filter_with([], salt=1,
+                                              num_bits=1 << 14)),
+        ]
+        with pytest.raises(ValueError, match="geometry"):
+            scorer.score(cells, [["kk"]])

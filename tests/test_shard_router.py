@@ -463,6 +463,27 @@ class TestHomeShardPinning:
         finally:
             router.stop()
 
+    def test_anonymous_digest_pins_by_ring(self):
+        """ISSUE 19: an anonymous request WITH an env digest pins to
+        the digest's ring shard — the same affinity signal cell-level
+        homing uses — instead of smearing round-robin; only the fully
+        anonymous call draws from the round-robin counter."""
+        router = _mk_router(4, steal=StealConfig(enabled=False))
+        try:
+            home = router.resolve_home("", ENV)
+            assert 0 <= home < 4
+            # Stable across calls, and never burns a round-robin slot.
+            assert router.resolve_home("", ENV) == home
+            assert router.resolve_home("") == 0
+            assert router.resolve_home("") == 1
+            assert router.resolve_home("", ENV) == home
+            # Distinct digests spread over the ring, all in range.
+            homes = {router.resolve_home("", f"{i:08x}" * 8)
+                     for i in range(32)}
+            assert homes <= set(range(4)) and len(homes) > 1
+        finally:
+            router.stop()
+
 
 class TestStealSatisfiedPrefetch:
     def test_prefetch_served_when_steal_covers_immediate(self):

@@ -206,6 +206,16 @@ if ! python -m yadcc_tpu.tools.cluster_sim --scenario cold-region --smoke; then
   echo "chaos smoke (cold-region) FAILED" >&2
   fail=1
 fi
+# Scored spillover placement (doc/scheduler.md "Federation"): the
+# device cells×tasks cost matrix must land spills on the warm peer
+# despite its higher load (>= 1.3x the least-loaded baseline's
+# post-spill hit rate, 0 errors, every decision scored) and still
+# divert to the cold peer once the warm one fills solid.  The
+# host-vs-device parity oracle itself is tier-1 (tests/test_placement).
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario spill-affinity --smoke; then
+  echo "chaos smoke (spill-affinity) FAILED" >&2
+  fail=1
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -214,9 +214,11 @@ class AnalyzerConfig:
     hot_path_fragments: Tuple[str, ...] = ("scheduler", "daemon")
     # Path fragments selecting the modules where jit hygiene applies.
     # device_pool.py rides along: it is the scheduler-side owner of the
-    # jitted resident step and its static-arg discipline.
+    # jitted resident step and its static-arg discipline; placement.py
+    # likewise owns the scored-spill launch's compiled-variant cache.
     jit_path_fragments: Tuple[str, ...] = ("ops", "parallel",
-                                           "device_pool.py")
+                                           "device_pool.py",
+                                           "placement.py")
     # Path fragments selecting the modules where aio-blocking applies
     # (the event-loop front end: coroutines there must never block).
     # "cloud" pulls in daemon/cloud/ — the parked servant wait
@@ -228,10 +230,12 @@ class AnalyzerConfig:
     # hot loop, where any unsanctioned np.asarray/block_until_ready
     # stalls the fused launch pipeline.  federation.py / replication.py
     # ride along (ISSUE 18): cell routing and journal replay sit on the
-    # same cycle and must not host-sync either.
+    # same cycle and must not host-sync either; placement.py (ISSUE 19)
+    # hosts the scored-spill launch and its pick readback.
     device_sync_path_fragments: Tuple[str, ...] = (
         "device_pool.py", "shard_router.py", "policy.py",
-        "task_dispatcher.py", "federation.py", "replication.py")
+        "task_dispatcher.py", "federation.py", "replication.py",
+        "placement.py")
     # Path fragments (filename parts) selecting the modules where the
     # replication / exactly-once family (repl-journal-skip,
     # repl-journal-under-lock, grant-id-arith, takeover-order) applies.

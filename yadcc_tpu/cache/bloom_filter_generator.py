@@ -109,6 +109,18 @@ class BloomFilterGenerator:
         with self._lock:
             return self._filter.to_bytes()
 
+    def snapshot(self) -> bloom.SaltedBloomFilter:
+        """Point-in-time copy of the filter for out-of-band consumers
+        — the spill-placement scorer (scheduler/placement.py) probes
+        per-cell snapshots for candidate-key warmth.  A copy, not a
+        view: the generator keeps mutating its live filter under its
+        own lock, and the scorer's staleness contract is "as of the
+        snapshot", never "torn mid-add"."""
+        with self._lock:
+            data = self._filter.to_bytes()
+        return bloom.SaltedBloomFilter.from_bytes(
+            data, self._num_hashes, self._salt, num_bits=self._num_bits)
+
     def may_contain(self, key: str) -> bool:
         with self._lock:
             return self._filter.may_contain(key)

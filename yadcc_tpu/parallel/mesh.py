@@ -693,3 +693,92 @@ def shard_load_summary_fn(mesh: Mesh):
         check_vma=False,
     )
     return jax.jit(fn)
+
+
+def placement_score_fn(mesh: Mesh, *, length: int, num_bits: int,
+                       num_hashes: int, t_max: int, warm_scale: int,
+                       w_warm: int, w_load: int, w_topo: int):
+    """Cells×tasks spill-placement cost matrix in ONE launch — the
+    federation half of the fused control plane (doc/scheduler.md
+    "Federation": scored spillover).  The CELL axis shards over the
+    mesh; each device holds whole region-filter word arrays for its
+    cell slice, probes every candidate key against each of them (the
+    same fused digest→probe chain as sharded_bloom_cascade_fn, vmapped
+    over local cells), folds the per-task hit counts into an integer
+    warmth term, and adds the load/topology terms.  Argmin per task
+    resolves in-kernel: local argmin over the device's cell rows
+    (jnp.argmin's first-occurrence = lowest local row = lowest global
+    cell, slots being linear-device-major), then one [t_max] pmin pair
+    per mesh axis — the sharded_assign_fn lowest-slot tie-break.
+
+    All score math is int32 so the host oracle
+    (scheduler/placement.py:reference_scores) is bit-exact:
+      miss_q[c,t] = (counts[t] - hits[c,t]) * warm_scale
+                      // max(counts[t], 1)        (warm_scale if no
+                                                   filter data for c)
+      score[c,t]  = w_warm*miss_q + w_load*util_q[c] + w_topo*topo_q[c]
+    with ineligible cells forced to the 2**30 sentinel (same BIG the
+    assignment kernels use; best_score >= BIG means "no peer").
+
+    Inputs (C_pad = cells padded to a device multiple, W words per
+    filter, N packed keys, padding keys carry task_of_key == -1):
+      words        uint32[C_pad, W]  P(axes, None)  region filter words
+      seeds        uint32[C_pad, 2]  P(axes, None)  per-cell salt seeds
+      util_q/topo_q/eligible/has_filter  int32[C_pad]  P(axes)
+      packed       uint32[N, kw]     replicated     pack_key_buckets
+      task_of_key  int32[N]          replicated
+      counts       int32[t_max]      replicated     kept keys per task
+    Returns (scores int32[C_pad, t_max] sharded, best_cell int32[t_max]
+    replicated, best_score int32[t_max] replicated).
+    """
+    from ..ops.bloom_probe import probe_body
+    from ..ops.xxh64_jax import xxh64_device
+
+    axes = tuple(mesh.axis_names)
+    big = jnp.int32(2**30)
+    wscale = jnp.int32(warm_scale)
+
+    def body(words, seeds, util_q, topo_q, eligible, has_filter,
+             packed, task_of_key, counts):
+        cpd = words.shape[0]                 # cells on this device
+        base = device_linear_index(mesh, axes) * cpd
+
+        def probe_cell(cell_words, seed):
+            # Fused digest→probe, whole filter local (cells are the
+            # sharded axis here, not filter words); keep the split in
+            # lockstep with ops/bloom_pipeline.py.
+            hi, lo = xxh64_device(packed, length, seed)
+            fps = jnp.stack([lo, hi | jnp.uint32(1)], axis=1)
+            return probe_body(cell_words, fps, num_bits, num_hashes)
+
+        ok = jax.vmap(probe_cell)(words, seeds)          # bool[cpd, N]
+        onehot = (task_of_key[:, None] ==
+                  jnp.arange(t_max, dtype=jnp.int32)[None, :])
+        hits = (ok[:, :, None] & onehot[None, :, :]).sum(1)  # [cpd, t]
+        hits = hits.astype(jnp.int32)
+        denom = jnp.maximum(counts, 1)[None, :]
+        miss_q = ((counts[None, :] - hits) * wscale) // denom
+        miss_q = jnp.where(has_filter[:, None] > 0, miss_q, wscale)
+        score = (jnp.int32(w_warm) * miss_q
+                 + (jnp.int32(w_load) * util_q
+                    + jnp.int32(w_topo) * topo_q)[:, None])
+        score = jnp.where(eligible[:, None] > 0, score, big)
+
+        best_score = score.min(axis=0)                      # [t_max]
+        best_cell = base + jnp.argmin(score, axis=0).astype(jnp.int32)
+        for name in reversed(axes):  # innermost axis reduces first
+            axis_score = jax.lax.pmin(best_score, name)
+            cand = jnp.where(best_score == axis_score, best_cell, big)
+            best_cell = jax.lax.pmin(cand, name)
+            best_score = axis_score
+        return score, best_cell, best_score
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes), P(axes),
+                  P(axes), P(axes), P(), P(), P()),
+        out_specs=(P(axes, None), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -11,12 +11,17 @@ Two cross-cell mechanisms, both deliberately narrow:
 
 * **Spillover** (the admission rung between SHED_OPTIONAL and
   LOCAL_ONLY; scheduler/admission.py): when the home cell's ladder has
-  climbed to RUNG_SPILLOVER, new grant requests are forwarded to the
-  least-loaded peer cell that still has headroom — remote capacity
-  beats telling the delegate to burn its local CPU.  Grants carry cell
-  provenance (``cell_id`` / ``spilled`` on the wire) and stay
-  *cell-namespaced*: renewals and frees route home by grant-id
-  arithmetic alone, no table.
+  climbed to RUNG_SPILLOVER, new grant requests are forwarded to a
+  peer cell that still has headroom — remote capacity beats telling
+  the delegate to burn its local CPU.  The peer is picked by a SCORED
+  placement decision (scheduler/placement.py): a cells×tasks cost
+  matrix fusing cache warmth (per-cell region-filter snapshots probed
+  for the request's candidate keys), load, and topology distance,
+  computed in one device launch with the argmin in-kernel; the ladder
+  degrades scored → least-loaded → ``spill_no_peer`` when warmth data
+  is missing.  Grants carry cell provenance (``cell_id`` / ``spilled``
+  on the wire) and stay *cell-namespaced*: renewals and frees route
+  home by grant-id arithmetic alone, no table.
 * **Takeover swap**: a cell's dispatcher is reached through its
   :class:`CellHandle`; a standby promotion swaps the handle's
   dispatcher in place and every peer's spillover path follows without
@@ -34,14 +39,20 @@ is what makes the cell-kill double-run check meaningful.
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
+from ..common.bloom import SaltedBloomFilter
 from ..common.consistent_hash import (SCHEDULER_VNODES_PER_WEIGHT,
                                       ConsistentHash)
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
+from ..utils.stagetimer import StageTimer
 from .admission import RUNG_SPILLOVER, AdmissionDecision
+from .placement import (BIG as _SCORE_BIG, CellCandidate,
+                        host_reference_placement)
 from .shard_router import RoutedGrant, RoutedGrants
 
 logger = get_logger("scheduler.federation")
@@ -94,6 +105,35 @@ class CellDirectory:
     def home_cell(self, env_digest: str) -> int:
         return int(self._ring.pick(env_digest))
 
+    def home_cell_scored(self, env_digest: str,
+                         keys: Sequence[str] = (),
+                         filters: Optional[Sequence[
+                             Optional[SaltedBloomFilter]]] = None,
+                         utilizations: Optional[Sequence[float]] = None,
+                         ) -> int:
+        """Affinity homing for clients that know their candidate cache
+        keys: score every cell with the HOST reference scorer
+        (scheduler/placement.py — the client has no accelerator
+        mandate; the arithmetic is the same int32 math the device
+        kernel runs server-side) and home to the warmest.  Keyless
+        clients, or clients without any per-cell filter snapshot, fall
+        back to the consistent-hash pick — the ring stays the stability
+        anchor, scoring only refines it when warmth data exists."""
+        if (not keys or filters is None
+                or not any(f is not None for f in filters)):
+            return self.home_cell(env_digest)
+        n = len(self._uris)
+        utils = list(utilizations) if utilizations is not None else []
+        cells = [CellCandidate(
+                     cell_id=i,
+                     utilization=(utils[i] if i < len(utils) else 0.0),
+                     filter=(filters[i] if i < len(filters) else None))
+                 for i in range(n)]
+        res = host_reference_placement(cells, [list(keys)])
+        if res is None or int(res.best_score[0]) >= _SCORE_BIG:
+            return self.home_cell(env_digest)
+        return int(res.best_cell[0])
+
     def uri(self, cell: int) -> str:
         """The cell's dialing URI — possibly a comma-separated
         active,standby list (rpc.FailoverChannel)."""
@@ -115,9 +155,19 @@ class FederationRouter:
     worker-pool path here — same trade the sharded router makes.
     """
 
+    # Candidate-key ring sizing: enough recent keys per env for a
+    # meaningful warmth sample, bounded envs so a digest churn can't
+    # grow the table without limit.
+    _KEYS_PER_ENV = 32
+    _MAX_ENVS = 256
+
     def __init__(self, cells: Sequence[CellHandle], my_cell: int, *,
                  shards_per_cell: int = 1,
                  spill_max_batch: int = 8,
+                 signal_ttl_s: float = 0.1,
+                 topology_distance: Optional[Sequence[int]] = None,
+                 use_scored_placement: bool = True,
+                 placement_scorer: Optional[object] = None,
                  clock: Clock = REAL_CLOCK):
         if not cells:
             raise ValueError("federation needs at least one cell")
@@ -127,12 +177,41 @@ class FederationRouter:
         self._my_cell = my_cell
         self._n_shards = max(1, shards_per_cell)
         self._spill_max_batch = spill_max_batch
+        self._signal_ttl_s = signal_ttl_s
+        self._use_scored = use_scored_placement
+        self._topo = (list(topology_distance)
+                      if topology_distance is not None
+                      else [0] * len(self._cells))
+        if len(self._topo) != len(self._cells):
+            raise ValueError(
+                f"topology_distance needs {len(self._cells)} entries, "
+                f"got {len(self._topo)}")
         self._clock = clock
         self._lock = threading.Lock()  # leaf: counters only
         self._stats = {"spilled_requests": 0, "spilled_grants": 0,
                        "spill_no_peer": 0,
                        "foreign_renewals": 0,
-                       "foreign_frees": 0}  # guarded by: self._lock
+                       "foreign_frees": 0,
+                       "signal_refreshes": 0,
+                       "signal_cache_hits": 0,
+                       "placement_scored": 0,
+                       "placement_fallback_least_loaded": 0,
+                       }  # guarded by: self._lock
+        self._spill_by_peer: Dict[int, int] = {}  # guarded by: self._lock
+        # Affinity state for the scored spill path — a separate leaf
+        # lock so warmth bookkeeping never contends with the counter
+        # path, and NEVER held across a dispatcher or device call.
+        self._affinity_lock = threading.Lock()
+        self._scorer = placement_scorer  # guarded by: self._affinity_lock (lazy init)
+        self._keys_by_env: "OrderedDict[str, Deque[str]]" = \
+            OrderedDict()  # guarded by: self._affinity_lock
+        self._cell_filters: Dict[int, SaltedBloomFilter] = \
+            {}  # guarded by: self._affinity_lock
+        self._signal_cache: Dict[int, Tuple[float, Optional[tuple]]] = \
+            {}  # guarded by: self._affinity_lock
+        # Placement-stage latency budget, surfaced in
+        # inspect()["federation"]["latency_breakdown"].
+        self.stage_timer = StageTimer()
 
     # -- plumbing ------------------------------------------------------------
 
@@ -160,13 +239,111 @@ class FederationRouter:
     def cell_of(self, grant_id: int) -> int:
         return cell_of_grant(grant_id, len(self._cells), self._n_shards)
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, object]:
         with self._lock:
-            return dict(self._stats)
+            out: Dict[str, object] = dict(self._stats)
+            out["spilled_grants_by_peer"] = dict(self._spill_by_peer)
+        return out
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._lock:
             self._stats[key] += n
+
+    def _bump_peer(self, cell_id: int, n: int) -> None:
+        with self._lock:
+            self._spill_by_peer[cell_id] = \
+                self._spill_by_peer.get(cell_id, 0) + n
+
+    def inspect(self) -> dict:
+        """Local-cell inspect() plus the federation block (the /inspect
+        surface rides this): spill counters with per-peer provenance
+        and the placement-stage latency budget, so an A/B can attribute
+        post-spill hit rate to placement decisions."""
+        out = dict(self._local().inspect())
+        out["federation"] = {
+            "cell_id": self._my_cell,
+            "n_cells": len(self._cells),
+            "stats": self.stats(),
+            "latency_breakdown": self.stage_timer.percentiles(),
+        }
+        return out
+
+    # -- affinity plumbing (scored spill placement) --------------------------
+
+    def note_candidate_keys(self, env_digest: str,
+                            keys: Sequence[str]) -> None:
+        """Record candidate cache keys for an env digest — the warmth
+        probes for the next spill decision under that digest.  Bounded
+        per-env ring + bounded env table (LRU eviction); dropping keys
+        only softens the warmth sample, never correctness."""
+        if not env_digest or not keys:
+            return
+        with self._affinity_lock:
+            ring = self._keys_by_env.get(env_digest)
+            if ring is None:
+                ring = deque(maxlen=self._KEYS_PER_ENV)
+                self._keys_by_env[env_digest] = ring
+            else:
+                self._keys_by_env.move_to_end(env_digest)
+            ring.extend(keys)
+            while len(self._keys_by_env) > self._MAX_ENVS:
+                self._keys_by_env.popitem(last=False)
+
+    def candidate_keys(self, env_digest: str) -> List[str]:
+        """Deduped recent candidate keys for a digest, oldest first."""
+        with self._affinity_lock:
+            ring = self._keys_by_env.get(env_digest)
+            snap = list(ring) if ring else []
+        seen: set = set()
+        out: List[str] = []
+        for k in snap:
+            if k not in seen:
+                seen.add(k)
+                out.append(k)
+        return out
+
+    def update_cell_filter(self, cell_id: int,
+                           snapshot: Optional[SaltedBloomFilter]) -> None:
+        """Install a peer cell's region-filter snapshot
+        (cache/bloom_filter_generator.py:snapshot) for warmth scoring.
+        None clears it.  Staleness contract: a snapshot answers "was
+        this key warm as of the snapshot" — the scorer never assumes
+        fresher; refresh cadence is the deployment's filter-sync
+        cadence (doc/scheduler.md "Federation")."""
+        with self._affinity_lock:
+            if snapshot is None:
+                self._cell_filters.pop(cell_id, None)
+            else:
+                self._cell_filters[cell_id] = snapshot
+
+    def _scorer_obj(self):
+        with self._affinity_lock:
+            if self._scorer is None:
+                from .placement import DevicePlacementScorer
+                self._scorer = DevicePlacementScorer()
+            return self._scorer
+
+    def _peer_state(self, cell: CellHandle) -> Optional[tuple]:
+        """(admission_rung, LoadSignal) for a peer, TTL-cached
+        (~signal_ttl_s) so a spill storm reads each peer once per
+        window instead of once per spill.  Failures (cell mid-takeover)
+        negative-cache for the same TTL.  The dispatcher calls happen
+        OUTSIDE every federation lock."""
+        now = self._clock.now()
+        with self._affinity_lock:
+            hit = self._signal_cache.get(cell.cell_id)
+        if hit is not None and now - hit[0] <= self._signal_ttl_s:
+            self._bump("signal_cache_hits")
+            return hit[1]
+        try:
+            state = (cell.dispatcher.admission_rung(),
+                     cell.dispatcher.load_signal())
+        except Exception:
+            state = None
+        with self._affinity_lock:
+            self._signal_cache[cell.cell_id] = (now, state)
+        self._bump("signal_refreshes")
+        return state
 
     # -- admission / home resolution ----------------------------------------
 
@@ -222,7 +399,7 @@ class FederationRouter:
         local = self._local()
         if (len(self._cells) > 1
                 and local.admission_rung() >= RUNG_SPILLOVER):
-            peer = self._pick_spill_peer()
+            peer = self._pick_spill_peer(env_digest)
             if peer is not None:
                 got = self._spill_to(peer, env_digest, min_version,
                                      requestor, immediate, lease_s,
@@ -254,28 +431,75 @@ class FederationRouter:
             g.cell_id = self._my_cell
         return out
 
-    def _pick_spill_peer(self) -> Optional[CellHandle]:
-        """Least-loaded peer cell that (a) is below the spillover rung
-        itself — never shift load onto a cell that is also shedding —
-        and (b) has free capacity right now.  Reads each peer's
-        load_signal() outside any federation lock (each call takes only
-        that dispatcher's own locks)."""
+    def _pick_spill_peer(self, env_digest: str = ""
+                         ) -> Optional[CellHandle]:
+        """Spill target by the placement fallback ladder
+        (doc/scheduler.md "Federation"):
+
+        1. **Scored** — when candidate keys were noted for this digest
+           and at least one eligible peer has a filter snapshot, build
+           the cells×tasks cost matrix (warmth + load + topology) in
+           ONE device launch (scheduler/placement.py) and take the
+           in-kernel argmin.  No per-peer host loop: the peers enter
+           the launch as one batch.
+        2. **Least-loaded** — no warmth data (or the scorer declined):
+           the pre-scoring behavior, lowest cached utilization.
+        3. **None** — no peer is eligible at all; the caller bumps
+           ``spill_no_peer`` and the request stays local.
+
+        Eligibility everywhere: a peer below the spillover rung — never
+        shift load onto a cell that is also shedding — with free
+        capacity per its (TTL-cached) signal.  Peer signals are read
+        through _peer_state outside any federation lock."""
+        t0 = time.perf_counter()
+        try:
+            return self._pick_spill_peer_inner(env_digest)
+        finally:
+            self.stage_timer.record("placement",
+                                    time.perf_counter() - t0)
+
+    def _pick_spill_peer_inner(self, env_digest: str
+                               ) -> Optional[CellHandle]:
+        peers = [c for c in self._cells if c.cell_id != self._my_cell]
+        states = [self._peer_state(c) for c in peers]
+        eligible = [s is not None and s[0] < RUNG_SPILLOVER
+                    and s[1].free > 0 for s in states]
+        if not any(eligible):
+            return None
+
+        if self._use_scored and env_digest:
+            keys = self.candidate_keys(env_digest)
+            with self._affinity_lock:
+                filters = dict(self._cell_filters)
+            if keys and any(filters.get(p.cell_id) is not None
+                            for p, ok in zip(peers, eligible) if ok):
+                cands = [CellCandidate(
+                             cell_id=p.cell_id,
+                             utilization=(s[1].utilization
+                                          if s is not None else 0.0),
+                             topo_distance=self._topo[p.cell_id],
+                             eligible=ok,
+                             filter=filters.get(p.cell_id))
+                         for p, s, ok in zip(peers, states, eligible)]
+                try:
+                    res = self._scorer_obj().score(cands, [keys])
+                except Exception:
+                    logger.exception(
+                        "placement scorer failed; falling back to "
+                        "least-loaded")
+                    res = None
+                if (res is not None
+                        and int(res.best_score[0]) < _SCORE_BIG):
+                    self._bump("placement_scored")
+                    return peers[int(res.best_cell[0])]
+
         best: Optional[CellHandle] = None
         best_util = float("inf")
-        for cell in self._cells:
-            if cell.cell_id == self._my_cell:
-                continue
-            d = cell.dispatcher
-            try:
-                if d.admission_rung() >= RUNG_SPILLOVER:
-                    continue
-                sig = d.load_signal()
-            except Exception:
-                continue  # cell mid-takeover: skip this round
-            if sig.free <= 0:
-                continue
-            if sig.utilization < best_util:
-                best, best_util = cell, sig.utilization
+        for p, s, ok in zip(peers, states, eligible):
+            if ok and s[1].utilization < best_util:
+                best, best_util = p, s[1].utilization
+        if best is not None:
+            self._bump("placement_fallback_least_loaded")
         return best
 
     def _spill_to(self, peer: CellHandle, env_digest: str,
@@ -296,6 +520,7 @@ class FederationRouter:
         if pairs:
             self._bump("spilled_requests")
             self._bump("spilled_grants", len(pairs))
+            self._bump_peer(peer.cell_id, len(pairs))
             logger.debug("spilled %d grant(s) cell %d -> %d",
                          len(pairs), self._my_cell, peer.cell_id)
         return out

@@ -1,0 +1,331 @@
+"""Scored spill placement: the cells×tasks affinity cost matrix.
+
+Spillover used to pick the least-loaded peer by one scalar utilization
+read, landing spilled tasks on cells whose cache tiers had never seen
+their keys.  This module makes placement a *scored* decision over three
+fused signals — cache warmth (each cell's region Bloom filter probed
+for the candidate keys), load (the peer signal the router already
+reads), and topology distance — evaluated as ONE batched device launch
+(parallel/mesh.py:placement_score_fn) with the per-task argmin resolved
+in-kernel.
+
+Two scorers, one arithmetic:
+
+* :func:`reference_scores` — the host parity oracle.  Pure int32 numpy
+  restating the kernel's exact math (integer warmth quantization,
+  floor-division, BIG sentinel for ineligible cells, first-occurrence
+  argmin = lowest-cell tie-break).  CI gates device output against it
+  bit-for-bit (tests/test_placement.py).
+* :class:`DevicePlacementScorer` — the production path: packs the
+  candidate keys, pads cells to the mesh's device grid, runs the fused
+  launch, reads back the picks.  No per-peer host loop anywhere.
+
+The warmth term is *sampled*, not exact: mixed-byte-length key batches
+keep only the dominant length class (:func:`prepare_probe_batch`), so
+the spill hot path stays one launch per decision instead of one per
+length bucket.  Dropped stragglers only soften the warmth estimate —
+placement correctness never depends on it (the fallback ladder in
+scheduler/federation.py degrades to least-loaded, then spill_no_peer).
+
+All scoring is int32 end to end:
+
+    miss_q[c,t] = (counts[t] - hits[c,t]) * WARM_SCALE
+                    // max(counts[t], 1)     (WARM_SCALE when cell c
+                                              has no filter snapshot)
+    score[c,t]  = W_WARM * miss_q[c,t]
+                  + W_LOAD * util_q[c] + W_TOPO * topo_q[c]
+
+with ineligible cells pinned to BIG; ``best_score >= BIG`` means "no
+placeable cell" and the caller walks down the fallback ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.bloom import SaltedBloomFilter
+
+# Warmth quantization scale: miss ratios land in [0, WARM_SCALE].  With
+# W_WARM = 4 a fully-cold cell pays 4096 score points — the load term
+# (utilization * WARM_SCALE) needs a 4x utilization gap to override a
+# warm/cold split, which is the "warmth beats moderate load imbalance"
+# policy doc/scheduler.md documents.
+WARM_SCALE = 1024
+W_WARM = 4
+W_LOAD = 1
+W_TOPO = 1
+# Same infeasible sentinel as the assignment kernels (parallel/mesh.py
+# `big`): any real score is far below it, so argmin never picks an
+# ineligible cell unless every cell is ineligible.
+BIG = 2 ** 30
+# Utilization clamp before quantization: the ladder has long since
+# shed/spilled by 32x, and the clamp keeps util_q * W_LOAD orders of
+# magnitude clear of int32 overflow.
+_UTIL_CLAMP = 32.0
+# Task axis is padded to a multiple of this so compile variants stay
+# bounded (spill decisions batch at most spill_max_batch = 8 tasks).
+_T_PAD = 8
+_N_PAD_MIN = 8
+
+
+def quantize_utilization(utilization: float) -> int:
+    """Host-side load quantization (input prep, shared by both scorers
+    — the parity surface starts at the int arrays, not here)."""
+    u = min(max(float(utilization), 0.0), _UTIL_CLAMP)
+    return int(round(u * WARM_SCALE))
+
+
+@dataclass
+class CellCandidate:
+    """One cell as the scorer sees it: identity, the (quantized-on-
+    entry) load and topology terms, and an optional region-filter
+    snapshot (cache/bloom_filter_generator.py:snapshot)."""
+
+    cell_id: int
+    utilization: float = 0.0
+    topo_distance: int = 0
+    eligible: bool = True
+    filter: Optional[SaltedBloomFilter] = None
+
+
+@dataclass
+class ProbeBatch:
+    """The kept candidate keys, packed for the device digest.  `kept`
+    mirrors `packed` row-for-row on the host side so the oracle probes
+    exactly the keys the kernel probes."""
+
+    length: int                       # byte length of the kept class
+    packed: np.ndarray                # uint32[N, kw]
+    task_of_key: np.ndarray           # int32[N]
+    counts: np.ndarray                # int32[T] kept keys per task
+    kept: List[List[str]]             # per-task kept keys (host oracle)
+    dropped: int = 0                  # stragglers outside the class
+
+
+@dataclass
+class PlacementResult:
+    scores: np.ndarray                # int32[C, T]
+    best_cell: np.ndarray             # int32[T] candidate INDEX per task
+    best_score: np.ndarray            # int32[T]
+    batch: ProbeBatch
+    device: bool = False              # which scorer produced it
+
+
+def prepare_probe_batch(
+        keys_per_task: Sequence[Sequence[str]]) -> Optional[ProbeBatch]:
+    """Flatten per-task candidate keys and keep the dominant byte-length
+    class (ops/bloom_pipeline.py:pack_key_buckets layout).  Warmth is a
+    sampled signal: one launch per decision beats one per length class,
+    and `dropped` records what the sample excluded.  Returns None when
+    there are no keys at all (callers fall back to least-loaded)."""
+    from ..ops.bloom_pipeline import pack_key_buckets
+
+    flat: List[str] = []
+    owner: List[int] = []
+    for t, ks in enumerate(keys_per_task):
+        for k in ks:
+            flat.append(k)
+            owner.append(t)
+    if not flat:
+        return None
+    buckets = pack_key_buckets(flat)
+    length, idxs, packed = max(buckets, key=lambda b: b[2].shape[0])
+    idx_arr = (np.arange(len(flat)) if isinstance(idxs, slice)
+               else np.asarray(idxs))  # ytpu: allow(device-sync)  # host index list
+    owner_arr = np.asarray(owner, np.int32)  # ytpu: allow(device-sync)  # host list
+    task_of_key = owner_arr[idx_arr]
+    counts = np.bincount(task_of_key,
+                         minlength=len(keys_per_task)).astype(np.int32)
+    kept: List[List[str]] = [[] for _ in keys_per_task]
+    for i in idx_arr:
+        kept[owner_arr[i]].append(flat[i])
+    return ProbeBatch(length=length,
+                      packed=np.ascontiguousarray(packed),
+                      task_of_key=task_of_key.astype(np.int32),
+                      counts=counts, kept=kept,
+                      dropped=len(flat) - len(idx_arr))
+
+
+def reference_scores(hits: np.ndarray, counts: np.ndarray,
+                     util_q: np.ndarray, topo_q: np.ndarray,
+                     eligible: np.ndarray, has_filter: np.ndarray,
+                     *, w_warm: int = W_WARM, w_load: int = W_LOAD,
+                     w_topo: int = W_TOPO
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """THE host restatement of placement_score_fn's score math — int32,
+    floor division, BIG sentinel, np.argmin's first occurrence as the
+    lowest-cell tie-break.  Any edit here must land in the kernel too;
+    tests/test_placement.py holds them bit-equal."""
+    hits = np.asarray(hits, np.int32)  # ytpu: allow(device-sync)  # host oracle input
+    counts = np.asarray(counts, np.int32)  # ytpu: allow(device-sync)  # host oracle input
+    denom = np.maximum(counts, 1)[None, :]
+    miss_q = ((counts[None, :] - hits) * np.int32(WARM_SCALE)) // denom
+    miss_q = np.where(np.asarray(has_filter)[:, None] > 0,  # ytpu: allow(device-sync)  # host oracle input
+                      miss_q, np.int32(WARM_SCALE))
+    score = (np.int32(w_warm) * miss_q
+             + (np.int32(w_load) * np.asarray(util_q, np.int32)  # ytpu: allow(device-sync)  # host oracle input
+                + np.int32(w_topo) * np.asarray(topo_q, np.int32))  # ytpu: allow(device-sync)  # host oracle input
+             [:, None]).astype(np.int32)
+    score = np.where(np.asarray(eligible)[:, None] > 0,  # ytpu: allow(device-sync)  # host oracle input
+                     score, np.int32(BIG))
+    best_cell = np.argmin(score, axis=0).astype(np.int32)
+    best_score = score[best_cell, np.arange(score.shape[1])]
+    return score, best_cell, best_score
+
+
+def _candidate_arrays(cells: Sequence[CellCandidate]
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+    util_q = np.asarray([quantize_utilization(c.utilization)  # ytpu: allow(device-sync)  # host list
+                         for c in cells], np.int32)
+    topo_q = np.asarray([int(c.topo_distance) for c in cells], np.int32)  # ytpu: allow(device-sync)  # host list
+    eligible = np.asarray([1 if c.eligible else 0 for c in cells],  # ytpu: allow(device-sync)  # host list
+                          np.int32)
+    has_filter = np.asarray([1 if c.filter is not None else 0  # ytpu: allow(device-sync)  # host list
+                             for c in cells], np.int32)
+    return util_q, topo_q, eligible, has_filter
+
+
+def host_reference_placement(
+        cells: Sequence[CellCandidate],
+        keys_per_task: Sequence[Sequence[str]]
+        ) -> Optional[PlacementResult]:
+    """Full-chain host oracle: per-cell filter probes via the host
+    may_contain path, then reference_scores.  Same dominant-bucket key
+    selection as the device path, so the two chains see identical
+    inputs."""
+    batch = prepare_probe_batch(keys_per_task)
+    if batch is None:
+        return None
+    hits = np.zeros((len(cells), len(batch.kept)), np.int32)
+    for ci, cell in enumerate(cells):
+        if cell.filter is None:
+            continue
+        for t, ks in enumerate(batch.kept):
+            if ks:
+                hits[ci, t] = int(np.count_nonzero(
+                    cell.filter.may_contain_batch(ks)))
+    util_q, topo_q, eligible, has_filter = _candidate_arrays(cells)
+    score, best_cell, best_score = reference_scores(
+        hits, batch.counts, util_q, topo_q, eligible, has_filter)
+    return PlacementResult(score, best_cell, best_score, batch,
+                           device=False)
+
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    if a.shape[0] >= n:
+        return a
+    pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)
+
+
+class DevicePlacementScorer:
+    """Production scorer: ONE fused launch per placement decision.
+
+    Cells pad to the mesh's device multiple (padding rows are
+    ineligible, zero-word filters), keys pad to a power-of-two row
+    count with task_of_key == -1 sentinels, tasks pad to an 8-multiple
+    — so the jit cache stays bounded at a handful of shape variants.
+    Compiled fns cache per (length, num_bits, num_hashes, c_pad, n_pad,
+    t_pad), the DeviceBloomCascade discipline.
+    """
+
+    def __init__(self, mesh=None):
+        from ..parallel import mesh as pmesh
+
+        self._mesh = mesh if mesh is not None else pmesh.make_mesh()
+        self._n_dev = int(np.prod([self._mesh.shape[a]
+                                   for a in self._mesh.axis_names]))
+        self._lock = threading.Lock()
+        self._fns = {}  # guarded by: self._lock (jit cache)
+
+    def _fn(self, length: int, num_bits: int, num_hashes: int,
+            c_pad: int, n_pad: int, t_pad: int):
+        from ..parallel import mesh as pmesh
+
+        key = (length, num_bits, num_hashes, c_pad, n_pad, t_pad)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = pmesh.placement_score_fn(
+                    self._mesh, length=length, num_bits=num_bits,
+                    num_hashes=num_hashes, t_max=t_pad,
+                    warm_scale=WARM_SCALE, w_warm=W_WARM,
+                    w_load=W_LOAD, w_topo=W_TOPO)
+                self._fns[key] = fn
+        return fn
+
+    def score(self, cells: Sequence[CellCandidate],
+              keys_per_task: Sequence[Sequence[str]]
+              ) -> Optional[PlacementResult]:
+        """(scores [C, T], best candidate index per task, best score) —
+        device-computed, bit-equal to host_reference_placement.
+        Returns None when there are no candidate keys or no cell has a
+        filter snapshot (no warmth signal: the scored path has nothing
+        to add over least-loaded)."""
+        import jax.numpy as jnp
+
+        from ..parallel import mesh as pmesh
+
+        filters = [c.filter for c in cells if c.filter is not None]
+        if not cells or not filters:
+            return None
+        batch = prepare_probe_batch(keys_per_task)
+        if batch is None:
+            return None
+        num_bits = filters[0].num_bits
+        num_hashes = filters[0].num_hashes
+        for f in filters[1:]:
+            if (f.num_bits, f.num_hashes) != (num_bits, num_hashes):
+                raise ValueError(
+                    "placement filters must share geometry: "
+                    f"({f.num_bits}, {f.num_hashes}) != "
+                    f"({num_bits}, {num_hashes})")
+
+        c_n, t_n, n_keys = (len(cells), len(batch.kept),
+                            batch.packed.shape[0])
+        c_pad = pmesh.pad_to_multiple(c_n, self._n_dev)
+        t_pad = pmesh.pad_to_multiple(max(t_n, 1), _T_PAD)
+        n_pad = _N_PAD_MIN
+        while n_pad < n_keys:
+            n_pad *= 2
+
+        nwords = (num_bits + 31) // 32
+        words = np.zeros((c_pad, nwords), np.uint32)
+        seeds = np.zeros((c_pad, 2), np.uint32)  # seed_pair layout
+        for ci, cell in enumerate(cells):
+            if cell.filter is not None:
+                words[ci] = cell.filter.words
+                s = cell.filter.salt & 0xFFFFFFFFFFFFFFFF
+                seeds[ci] = (s >> 32, s & 0xFFFFFFFF)
+        util_q, topo_q, eligible, has_filter = _candidate_arrays(cells)
+
+        fn = self._fn(batch.length, num_bits, num_hashes, c_pad, n_pad,
+                      t_pad)
+        scores_d, best_cell_d, best_score_d = fn(
+            jnp.asarray(words), jnp.asarray(seeds),
+            jnp.asarray(_pad_rows(util_q, c_pad)),
+            jnp.asarray(_pad_rows(topo_q, c_pad)),
+            jnp.asarray(_pad_rows(eligible, c_pad)),
+            jnp.asarray(_pad_rows(has_filter, c_pad)),
+            jnp.asarray(_pad_rows(batch.packed, n_pad)),
+            jnp.asarray(_pad_rows(batch.task_of_key, n_pad) +
+                        np.where(np.arange(n_pad) < n_keys, 0, -1
+                                 ).astype(np.int32)),
+            jnp.asarray(_pad_rows(batch.counts, t_pad)))
+        # The decision readback IS the launch's product — a [T] pick
+        # vector, not pool state; sanctioned sync point.
+        scores = np.asarray(  # ytpu: allow(device-sync)  # pick readback
+            scores_d)[:c_n, :t_n]
+        best_cell = np.asarray(  # ytpu: allow(device-sync)  # pick readback
+            best_cell_d)[:t_n]
+        best_score = np.asarray(  # ytpu: allow(device-sync)  # pick readback
+            best_score_d)[:t_n]
+        return PlacementResult(scores.astype(np.int32),
+                               best_cell.astype(np.int32),
+                               best_score.astype(np.int32), batch,
+                               device=True)

@@ -286,20 +286,22 @@ class ShardRouter:
     def resolve_home(self, requestor: str, env_digest: str = "") -> int:
         """Home shard for a grant request: the requestor's consistent-
         hash shard (delegates are pinned, so their keep-alive/free
-        traffic and their grants co-locate), round-robin when the
-        caller is anonymous.  Round-robin draws a FRESH shard per
-        call, so a caller pairing an admission ruling with a grant
-        request must resolve once and pass the shard to both (the
-        ``home`` kwarg) — otherwise an anonymous request is ruled on
-        one shard's ladder and queued on another's.
-
-        ``env_digest`` is accepted for surface parity with the
-        federation router, which routes by the task's cache-key prefix
-        (cache-affinity cell placement); within one cell the requestor
-        pin is the better locality signal, so it is ignored here."""
-        del env_digest
+        traffic and their grants co-locate).  Anonymous callers WITH an
+        ``env_digest`` pin to the digest's ring shard instead — the
+        cache-key prefix is a stable affinity signal (the same one
+        cell-level homing uses; doc/scheduler.md "Federation"), so a
+        digest's anonymous requests concentrate on one shard's grant
+        books rather than smearing round-robin.  Only when BOTH are
+        empty does round-robin apply, and it draws a FRESH shard per
+        call: a caller pairing an admission ruling with a grant request
+        must resolve once and pass the shard to both (the ``home``
+        kwarg) — otherwise an anonymous request is ruled on one shard's
+        ladder and queued on another's."""
         if requestor:
             return self.shard_for_location(requestor)
+        if env_digest:
+            return int(self._ring.pick("env:" + env_digest)[
+                len("shard"):])
         with self._lock:
             return next(self._rr) % len(self._shards)
 

@@ -51,6 +51,15 @@ Scenarios (``cluster_sim --scenario <name>|all``):
                      prefetch must reach 90% of the warm region's
                      steady hit rate >= 2x faster than read-through
                      promotion alone
+    spill-affinity   three federated cells, the home cell pinned at
+                     the spillover rung; a zipf key stream spills
+                     under scored placement (device cost matrix:
+                     warmth + load + topology) vs the least-loaded
+                     baseline.  Scored must land spills on the WARM
+                     peer despite its higher load — >= 1.3x the
+                     baseline's post-spill hit rate, 0 errors — and
+                     must divert to the cold peer once the warm one
+                     fills solid (the load term still binds)
 
 Each scenario returns a JSON-able dict with its measurements, its SLO
 bounds, and a per-bound pass flag; ``run_matrix`` aggregates them into
@@ -79,7 +88,8 @@ from ..scheduler.admission import (RUNG_NAMES, RUNG_NORMAL, RUNG_REJECT,
 
 SCENARIO_NAMES = ("wan-jitter", "burst", "flaky-servant", "slow-loris",
                   "oversized-tu", "cache-restart", "overload-ladder",
-                  "aot-storm", "cell-kill", "cold-region")
+                  "aot-storm", "cell-kill", "cold-region",
+                  "spill-affinity")
 
 
 # --------------------------------------------------------------------------
@@ -1402,6 +1412,221 @@ def _scn_cold_region_in(tmp: Path, smoke: bool) -> dict:
     return out
 
 
+def _scn_spill_affinity(smoke: bool) -> dict:
+    import shutil
+
+    tmp = Path(tempfile.mkdtemp(prefix="spillaffinity_"))
+    try:
+        return _scn_spill_affinity_in(tmp, smoke)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _scn_spill_affinity_in(tmp: Path, smoke: bool) -> dict:
+    """Warm-vs-cold spill placement A/B (ISSUE 19 tentpole).
+
+    Three federated cells, home cell 0 pinned at the spillover rung.
+    Cell 1 is WARM — its cache tiers hold the whole key universe, its
+    region-filter snapshot is installed on the router — but carries a
+    sticky load (parked grants) that keeps its utilization above cell
+    2's.  Cell 2 is COLD and idle.  A zipf key stream then spills,
+    twice: once with scored placement (the device cells×tasks cost
+    matrix, scheduler/placement.py) and once with the pre-scoring
+    least-loaded baseline.  A spill "hits" when the chosen cell's
+    cache already held the key; either way the artifact then warms the
+    chosen cell (cache set + filter), so the baseline gets full credit
+    for the locality it builds on its own.
+
+    The SLOs pin the tentpole's claims: scored placement lands on the
+    warm peer despite the load gap (>= 1.3x the baseline's post-spill
+    hit rate, every decision scored, 0 errors), the TTL'd signal cache
+    absorbs the storm's peer reads, load balance stays equal (no
+    residual outstanding on either peer), and once the warm peer fills
+    solid the load term diverts the next spill to the cold peer after
+    one signal-staleness window."""
+    from ..common.bloom import SaltedBloomFilter
+    from ..scheduler.admission import RUNG_SPILLOVER
+    from ..scheduler.federation import (CellHandle, FederationRouter,
+                                        grant_namespace_for_cell)
+    from ..scheduler.policy import GreedyCpuPolicy
+    from ..scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+    from ..utils.clock import REAL_CLOCK
+    from .trace_replay import generate_key_trace, load_key_trace
+
+    env = "feedc0de" * 8
+    n_keys = 120 if smoke else 200
+    draws = 160 if smoke else 240
+    sticky = 2           # grants parked on the warm peer (load gap)
+    capacity = 4
+
+    trace_path = str(tmp / "stream.jsonl")
+    # Flat-ish zipf: the baseline arm's hit rate is its repeat-draw
+    # fraction, and a flatter stream keeps that honest headroom below
+    # the scored arm's warm-cell rate.
+    universe = generate_key_trace(trace_path, keys=n_keys, draws=draws,
+                                  zipf_a=1.05, seed=23)
+    stream = load_key_trace(trace_path)
+
+    def run_arm(scored: bool) -> dict:
+        ds = []
+        for c in range(3):
+            start, stride = grant_namespace_for_cell(c, 3)
+            ds.append(TaskDispatcher(
+                GreedyCpuPolicy(), max_servants=16, max_envs=16,
+                clock=REAL_CLOCK, batch_window_s=0.0,
+                grant_id_start=start, grant_id_stride=stride))
+        try:
+            handles = [CellHandle(c, d) for c, d in enumerate(ds)]
+            # Warmth state: per-cell cache key sets plus the Bloom
+            # snapshots the scorer probes (the cold peer's EMPTY
+            # filter is installed too — "verifiably cold" beats "no
+            # data", which would force the least-loaded fallback).
+            cache_sets = {1: set(universe), 2: set()}
+            filters = {c: SaltedBloomFilter(1 << 15, 7, 1000 + c)
+                       for c in (1, 2)}
+            filters[1].add_many(list(universe))
+            scorer = None
+            if scored:
+                # Pre-compile the scorer's shape variants (candidate
+                # ring grows 1->32 keys, so n pads through 8/16/32),
+                # as a production boot would: the placement-stage p99
+                # then measures the launch, not trace-time.
+                from ..scheduler.placement import (CellCandidate,
+                                                   DevicePlacementScorer)
+                scorer = DevicePlacementScorer()
+                warm_cands = [CellCandidate(cell_id=c,
+                                            filter=filters[c])
+                              for c in (1, 2)]
+                for n in (8, 16, 32):
+                    scorer.score(warm_cands, [[universe[0]] * n])
+            router = FederationRouter(handles, 0,
+                                      use_scored_placement=scored,
+                                      placement_scorer=scorer)
+            for c, d in enumerate(ds):
+                d.keep_servant_alive(ServantInfo(
+                    location=f"10.9.{c}.1:1", version=1,
+                    num_processors=32, capacity=capacity,
+                    total_memory=64 << 30, memory_available=64 << 30,
+                    env_digests=(env,)), 60)
+            for c in (1, 2):
+                router.update_cell_filter(c, filters[c])
+            parked = ds[1].wait_for_starting_new_task(
+                env, immediate=sticky, timeout_s=2.0)
+            assert len(parked) == sticky, "sticky load failed to park"
+
+            hits = errors = local_fallthrough = 0
+            placements: Dict[int, int] = {}
+            for key in stream:
+                router.note_candidate_keys(env, [key])
+                ds[0].restore_admission_rung(RUNG_SPILLOVER)
+                routed = router.wait_for_starting_new_task_routed(
+                    env, timeout_s=2.0)
+                if not routed.grants:
+                    errors += 1
+                    continue
+                g = routed.grants[0]
+                if not g.spilled:
+                    local_fallthrough += 1
+                else:
+                    placements[g.cell_id] = \
+                        placements.get(g.cell_id, 0) + 1
+                    hits += int(key in cache_sets[g.cell_id])
+                    cache_sets[g.cell_id].add(key)
+                    filters[g.cell_id].add(key)
+                router.free_task([x.grant_id for x in routed.grants])
+
+            # Busy phase: fill the warm peer solid; after one signal
+            # staleness window the next spill must divert to the cold
+            # peer — warmth never overrides "no free capacity".
+            busy_diverted = None
+            if scored:
+                hold = ds[1].wait_for_starting_new_task(
+                    env, immediate=capacity, timeout_s=2.0)
+                time.sleep(0.15)        # one signal-TTL window
+                ds[0].restore_admission_rung(RUNG_SPILLOVER)
+                routed = router.wait_for_starting_new_task_routed(
+                    env, timeout_s=2.0)
+                busy_diverted = int(bool(routed.grants)
+                                    and routed.grants[0].spilled
+                                    and routed.grants[0].cell_id != 1)
+                ds[1].free_task([gid for gid, _ in hold])
+                router.free_task([x.grant_id for x in routed.grants])
+
+            stats = router.stats()
+            pct = router.stage_timer.percentiles().get("placement", {})
+            spilled = sum(placements.values())
+            residual = [ds[1].load_signal().outstanding - sticky,
+                        ds[2].load_signal().outstanding]
+            return {
+                "scored": scored,
+                "requests": len(stream),
+                "spilled": spilled,
+                "hits": hits,
+                "errors": errors,
+                "local_fallthrough": local_fallthrough,
+                "post_spill_hit_rate": round(hits / max(1, spilled), 4),
+                "placements": {str(c): n
+                               for c, n in sorted(placements.items())},
+                "busy_diverted": busy_diverted,
+                "residual_outstanding": residual,
+                "placement_scored": stats["placement_scored"],
+                "placement_fallback_least_loaded":
+                    stats["placement_fallback_least_loaded"],
+                "signal_refreshes": stats["signal_refreshes"],
+                "signal_cache_hits": stats["signal_cache_hits"],
+                "spilled_grants_by_peer": {
+                    str(c): n for c, n in sorted(
+                        stats["spilled_grants_by_peer"].items())},
+                "placement_p99_ms": round(pct.get("p99_ms", 0.0), 4),
+            }
+        finally:
+            for d in ds:
+                d.stop()
+
+    arm_scored = run_arm(scored=True)
+    arm_baseline = run_arm(scored=False)
+
+    ratio = (arm_scored["post_spill_hit_rate"]
+             / max(1e-9, arm_baseline["post_spill_hit_rate"]))
+    out = {
+        "keys": n_keys,
+        "stream_draws": draws,
+        "scored": arm_scored,
+        "baseline": arm_baseline,
+        "warm_hit_rate_ratio": round(ratio, 2),
+        "errors": arm_scored["errors"] + arm_baseline["errors"],
+        "local_fallthrough": arm_scored["local_fallthrough"]
+        + arm_baseline["local_fallthrough"],
+        "scored_hit_rate": arm_scored["post_spill_hit_rate"],
+        "scored_fallbacks":
+            arm_scored["placement_fallback_least_loaded"],
+        "baseline_scored_decisions": arm_baseline["placement_scored"],
+        "signal_cache_hits": arm_scored["signal_cache_hits"],
+        "busy_diverted": arm_scored["busy_diverted"],
+        "residual_outstanding_abs_max": max(
+            abs(x) for arm in (arm_scored, arm_baseline)
+            for x in arm["residual_outstanding"]),
+        "placement_score_p99_us": round(
+            arm_scored["placement_p99_ms"] * 1000.0, 1),
+    }
+    slo = {
+        "errors_max": 0,
+        "local_fallthrough_max": 0,
+        # The tentpole's headline: scored placement >= 1.3x the
+        # least-loaded baseline on post-spill cache hit rate.
+        "warm_hit_rate_ratio_min": 1.3,
+        "scored_hit_rate_min": 0.9,       # the warm peer really wins
+        "scored_fallbacks_max": 0,        # every decision was scored
+        "baseline_scored_decisions_max": 0,  # the A/B arms are clean
+        "signal_cache_hits_min": 1,       # the TTL cache engaged
+        "busy_diverted_min": 1,           # load term still binds
+        "residual_outstanding_abs_max_max": 0,  # equal load balance
+    }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
 def run_scenario(name: str, smoke: bool = False) -> dict:
     fn = {
         "wan-jitter": _scn_wan_jitter,
@@ -1414,6 +1639,7 @@ def run_scenario(name: str, smoke: bool = False) -> dict:
         "aot-storm": _scn_aot_storm,
         "cell-kill": _scn_cell_kill,
         "cold-region": _scn_cold_region,
+        "spill-affinity": _scn_spill_affinity,
     }[name]
     out = fn(smoke)
     out["scenario"] = name
@@ -1447,6 +1673,18 @@ def quick_hostile_metrics() -> dict:
         "survival_compile_success_rate": flaky["compile_success_rate"],
         "failover_time_ms": cellkill["failover_time_ms"],
         "cell_kill_success_rate": cellkill["compile_success_rate"],
+    }
+
+
+def quick_spill_affinity_metrics() -> dict:
+    """bench.py harness v14 canaries from one smoke spill-affinity
+    run: the scored arm's post-spill cache hit rate and the placement
+    stage's p99 in microseconds (the cost of one scored decision —
+    launch included)."""
+    sa = run_scenario("spill-affinity", smoke=True)
+    return {
+        "placement_warm_hit_rate": sa["scored_hit_rate"],
+        "placement_score_p99_us": sa["placement_score_p99_us"],
     }
 
 
