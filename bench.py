@@ -525,8 +525,27 @@ def main() -> None:
     except Exception:
         spill_affinity = {}
 
+    # Multi-tenant QoS canaries (tools/scenarios.py noisy-neighbor +
+    # cache-poisoning smokes, doc/tenancy.md): the victim tenant's
+    # fair-share ratio against a 100-pid adversary and the
+    # cryptographic cache-isolation proof bit.
+    try:
+        from yadcc_tpu.tools.scenarios import quick_tenancy_metrics
+
+        tenancy = quick_tenancy_metrics()
+    except Exception:
+        tenancy = {}
+
     result = {
         "metric": "scheduler_assignments_per_sec_5k_workers",
+        # Version 15 (r20+): adds `victim_tenant_slo_share` (the
+        # victim tenant's share of a shared grant queue under a
+        # 100-pid noisy neighbor in a smoke noisy-neighbor run —
+        # 1.0 means the two-level stride held the tenant boundary
+        # exactly) and `cross_tenant_isolation_ok` (1 iff the smoke
+        # cache-poisoning run proved cross-namespace reads AND
+        # poison plants both fail against tenant-scoped keys;
+        # doc/tenancy.md).  Every v14 field is still emitted.
         # Version 14 (r19+): adds `placement_warm_hit_rate` (post-spill
         # cache hit rate of the scored-placement arm in a smoke
         # spill-affinity run — spills landing on the warm peer despite
@@ -605,7 +624,7 @@ def main() -> None:
         # r01-r05 artifacts measured one extra batch in flight at the
         # same nominal window — do not compare r06+ numbers against
         # them at equal window settings without accounting for that.
-        "harness_version": 14,
+        "harness_version": 15,
         "value": round(per_sec, 1),
         "unit": "assignments/s",
         "vs_baseline": round(per_sec / target, 3),
@@ -666,6 +685,9 @@ def main() -> None:
             "placement_warm_hit_rate"),
         "placement_score_p99_us": spill_affinity.get(
             "placement_score_p99_us"),
+        "victim_tenant_slo_share": tenancy.get("victim_tenant_slo_share"),
+        "cross_tenant_isolation_ok": tenancy.get(
+            "cross_tenant_isolation_ok"),
         "pallas_ab": None,
         "pallas_grouped_ab": None,
         "device": str(jax.devices()[0]),

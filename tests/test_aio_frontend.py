@@ -605,6 +605,9 @@ class TestAsyncComponentApis:
                 def fairness_key(self):
                     return ""
 
+                def fairness_tenant(self):
+                    return ""
+
                 fairness_weight = 1.0
 
             # queue_task runs _perform_one_task on a thread; with no

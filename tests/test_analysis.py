@@ -564,7 +564,11 @@ def check_cap(n):  # ytpu: sanitizes(size-cap)
     return min(int(n), 1000)
 
 
-def derive_key(k):  # ytpu: sanitizes(key-domain)
+def derive_key(k):  # ytpu: sanitizes(key-domain, tenant-domain)
+    return "ns-" + str(k)
+
+
+def derive_untenanted(k):  # ytpu: sanitizes(key-domain)
     return "ns-" + str(k)
 
 
@@ -583,20 +587,36 @@ def handle_clean(self, req, body):  # ytpu: untrusted(req, body)
     return data, data2
 
 
+def handle_untenanted(self, req, body):  # ytpu: untrusted(req, body)
+    # Versioned prefix but NO tenant-domain separator: pre-tenancy
+    # idiom that would merge all tenants into one namespace.
+    self.cache.async_write(derive_untenanted(req.key), body)
+
+
 def handle_suppressed(self, req):  # ytpu: untrusted(req)
     return self.rfile.read(req.length)  # ytpu: allow(taint-alloc)  # fixture: bounded upstream by the transport frame cap
+
+
+def handle_key_suppressed(self, req, body):  # ytpu: untrusted(req, body)
+    self.cache.async_write(derive_untenanted(req.key), body)  # ytpu: allow(taint-cache-key)  # fixture: single-tenant surface by construction
 """
 
 
 def test_taint_family(tmp_path):
     findings, _ = run_snippet(tmp_path, TAINT_SNIPPET, subdir="daemon")
     assert len(live(findings, "taint-alloc")) == 1
-    assert len(live(findings, "taint-cache-key")) == 1
+    # Two: the raw req.key write AND the key-domain-only derivation
+    # (cache keys need the tenant-domain separator too —
+    # doc/tenancy.md).
+    tck = live(findings, "taint-cache-key")
+    assert len(tck) == 2
+    assert any("tenant-domain" in f.message for f in tck)
     assert len(live(findings, "taint-path")) == 1
     assert len(live(findings, "taint-argv")) == 1
-    # handle_clean contributes nothing; the suppression is honored.
+    # handle_clean contributes nothing; the suppressions are honored.
     sup = [f for f in findings if f.suppressed]
     assert any(f.rule == "taint-alloc" for f in sup)
+    assert any(f.rule == "taint-cache-key" for f in sup)
 
 
 def test_taint_interprocedural_wait(tmp_path):
@@ -741,12 +761,81 @@ REGISTRY = [
     assert len(tr) == 1 and "'video'" in tr[0].message
 
 
+def test_taint_registry_tenant_domain(tmp_path):
+    """Tenancy seam (doc/tenancy.md): a kind whose task class derives
+    cache keys with the versioned prefix but WITHOUT the tenant-domain
+    separator must fail lint — that workload's artifacts would share
+    one namespace across tenants.  The proof hops through the
+    constructor (factory -> task class -> its get_cache_key), and a
+    kind with no cache surface at all is exempt."""
+    findings, _ = run_snippet(tmp_path, """
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TaskType:
+    kind: str
+    make_task: object
+
+
+def checked_attachment(data):  # ytpu: sanitizes(size-cap)
+    return data
+
+
+def scoped_key(secret, digest):  # ytpu: sanitizes(key-domain, tenant-domain)
+    return "good1-" + digest
+
+
+def prefixed_key(digest):  # ytpu: sanitizes(key-domain)
+    return "bad1-" + digest
+
+
+class GoodTask:
+    def get_cache_key(self):
+        return scoped_key(self.tenant_key_secret, self.digest)
+
+
+class BadTask:
+    def get_cache_key(self):
+        return prefixed_key(self.digest)
+
+
+def make_good_task(msg, att):
+    return GoodTask(checked_attachment(att))
+
+
+def make_bad_task(msg, att):
+    return BadTask(checked_attachment(att))
+
+
+def make_keyless_task(msg, att):
+    return checked_attachment(att)  # no cache surface: exempt
+
+
+REGISTRY = [
+    TaskType(kind="good", make_task=lambda m, a: make_good_task(m, a)),
+    TaskType(kind="bad", make_task=lambda m, a: make_bad_task(m, a)),
+    TaskType(kind="keyless",
+             make_task=lambda m, a: make_keyless_task(m, a)),
+]
+""", subdir="daemon")
+    tr = live(findings, "taint-registry")
+    assert len(tr) == 1 and "'bad'" in tr[0].message
+    assert "tenant-domain" in tr[0].message
+
+
 def test_production_registry_passes_taint_registry():
     """The shipped four-kind registry must satisfy taint-registry by
     construction: every factory routes its attachment through
-    limits.checked_attachment."""
+    limits.checked_attachment AND derives its cache keys through the
+    tenant-domain separator (tenancy/keys.py tenant_scoped_key) —
+    both checks, zero findings."""
     findings, _ = analyze_paths([PKG_DIR], _package_config())
     assert not live(findings, "taint-registry")
+    # The tenant-domain leg really runs: every kind's key derivation
+    # is reachable (none exempt), so the zero above is a proof, not a
+    # vacuous pass.
+    assert not live(findings, "taint-cache-key")
     # And the registry really has all four kinds registered.
     from yadcc_tpu.daemon.local.file_digest_cache import FileDigestCache
     from yadcc_tpu.daemon.local.task_registry import default_registry

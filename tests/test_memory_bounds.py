@@ -216,7 +216,7 @@ def test_retired_fetcher_frees_in_flight_grants(monkeypatch):
     in_fetch = th.Event()
     release_fetch = th.Event()
 
-    def slow_fetch(env, immediate, prefetch):
+    def slow_fetch(env, immediate, prefetch, tenant=""):
         in_fetch.set()
         release_fetch.wait(5)
         return [(4242, "10.0.0.1:1")], 0, 0.0

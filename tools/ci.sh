@@ -216,6 +216,26 @@ if ! python -m yadcc_tpu.tools.cluster_sim --scenario spill-affinity --smoke; th
   echo "chaos smoke (spill-affinity) FAILED" >&2
   fail=1
 fi
+# Multi-tenant QoS tentpole (doc/tenancy.md): one adversary tenant
+# fanning demand across 100 client pids must not starve a single-pid
+# victim tenant below 0.8 of its tenant share (two-level stride);
+# an adversary who KNOWS a victim's plaintext cache key must neither
+# read nor poison the victim's artifact (tenant-domain key
+# separation); and under a driven overload ladder best-effort demand
+# must shed with native REJECT+retry-after while interactive keeps
+# minting real grants at the same rung.
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario noisy-neighbor --smoke; then
+  echo "chaos smoke (noisy-neighbor) FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario cache-poisoning --smoke; then
+  echo "chaos smoke (cache-poisoning) FAILED" >&2
+  fail=1
+fi
+if ! python -m yadcc_tpu.tools.cluster_sim --scenario tier-inversion --smoke; then
+  echo "chaos smoke (tier-inversion) FAILED" >&2
+  fail=1
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

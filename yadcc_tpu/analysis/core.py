@@ -114,13 +114,19 @@ RULES: Dict[str, str] = {
     "parse-error": "file could not be parsed",
 }
 
-# Sink kind -> sanitizer tags that clear it (taint family).
+# Sink kind -> sanitizer tags that clear it (taint family).  Cache
+# keys require BOTH the versioned-prefix discipline (key-domain) and
+# the tenant-domain separator (tenancy/keys.py): a key that reaches
+# the store without passing through tenant_scoped_key (or a helper
+# annotated as applying it) would silently merge tenants back into one
+# namespace — exactly the cross-tenant read/poison surface the
+# multi-tenant QoS tentpole closes (doc/tenancy.md).
 SINK_REQUIRED_TAGS: Dict[str, frozenset] = {
     "alloc": frozenset({"size-cap"}),
     "wait": frozenset({"size-cap"}),
     "path": frozenset({"path"}),
     "argv": frozenset({"argv"}),
-    "cache-key": frozenset({"key-domain"}),
+    "cache-key": frozenset({"key-domain", "tenant-domain"}),
 }
 
 # Factories whose call result is a lock / a condition.  Matched on the

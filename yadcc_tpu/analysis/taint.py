@@ -275,7 +275,11 @@ class _Summarizer:
                                       f"{dotted}(...)")
         if name in _CACHE_KEY_LAST and node.args:
             o, t = self._arg_state(node.args[0])
-            if o and "key-domain" not in t:
+            # Record unless the FULL required set is already applied:
+            # a key-domain-only derivation must still reach the
+            # worklist so the missing tenant-domain separator is
+            # reported (doc/tenancy.md).
+            if o and not SINK_REQUIRED_TAGS["cache-key"] <= t:
                 self._record_sink("cache-key", node.lineno, o, t,
                                   f"{dotted}(key)")
 
@@ -516,9 +520,33 @@ def check_global(functions: Sequence[FunctionInfo],
 def _reaches_sanitizer(name: str, by_name: Dict[str, List[FunctionInfo]],
                        sanitizer_map: Dict[str, Set[str]],
                        want: str = "size-cap",
-                       depth: int = 4) -> bool:
+                       depth: int = 4,
+                       class_methods: Optional[
+                           Dict[str, List[FunctionInfo]]] = None
+                       ) -> bool:
     """Does `name` (a factory) transitively call a helper annotated
-    ``sanitizes(<want>...)``?"""
+    ``sanitizes(<want>...)``?
+
+    ``class_methods`` (class name -> its method FunctionInfos) lets the
+    walk hop through a constructor: a factory that builds
+    ``CxxTask(...)`` reaches whatever the task's OWN methods reach.
+    The hop resolves methods by identity, not by bare name — a
+    same-named method on an unrelated class (every task class defines
+    ``get_cache_key``) must not lend its sanitizers to this one."""
+
+    def _scan(info: FunctionInfo, nxt: List[str]) -> bool:
+        if want in info.sanitizes:
+            return True
+        if info.taint:
+            for call in info.taint["calls"]:
+                nxt.append(call["callee"])
+            # calls without tainted args are not recorded in the
+            # taint summary; fall back to the sink/call-free scan
+            # recorded at summary time via all_callees.
+            for c in info.taint.get("all_callees", ()):
+                nxt.append(c)
+        return False
+
     seen: Set[str] = set()
     frontier = [name]
     for _ in range(depth + 1):
@@ -529,18 +557,14 @@ def _reaches_sanitizer(name: str, by_name: Dict[str, List[FunctionInfo]],
             seen.add(n)
             if want in sanitizer_map.get(n, set()):
                 return True
+            if class_methods and n in class_methods:
+                for info in class_methods[n]:
+                    if _scan(info, nxt):
+                        return True
+                continue
             for info in by_name.get(n, []):
-                if want in info.sanitizes:
+                if _scan(info, nxt):
                     return True
-                if not info.taint:
-                    continue
-                for call in info.taint["calls"]:
-                    nxt.append(call["callee"])
-                # calls without tainted args are not recorded in the
-                # taint summary; fall back to the sink/call-free scan
-                # recorded at summary time via all_callees.
-                for c in info.taint.get("all_callees", ()):
-                    nxt.append(c)
         frontier = nxt
         if not frontier:
             break
@@ -552,6 +576,14 @@ def _check_registry(tasktype_sites: Sequence[dict],
                     sanitizer_map: Dict[str, Set[str]]
                     ) -> List[Finding]:
     findings: List[Finding] = []
+    # Class name -> its method infos, for the constructor hop (a
+    # factory's cache keys are derived by the task object it builds).
+    class_methods: Dict[str, List[FunctionInfo]] = {}
+    for infos in by_name.values():
+        for info in infos:
+            if "." in info.qualname:
+                cls = info.qualname.rsplit(".", 2)[-2]
+                class_methods.setdefault(cls, []).append(info)
     for site in tasktype_sites:
         kind = site.get("kind") or "?"
         factories = [f for f in site.get("factories", ())
@@ -565,4 +597,25 @@ def _check_registry(tasktype_sites: Sequence[dict],
                 f"{site.get('factories') or '<unresolved>'} cannot be "
                 f"proven to route its intake through a "
                 f"sanitizes(size-cap) validation helper"))
+        # Tenancy seam (doc/tenancy.md): a kind that derives cache keys
+        # (reaches a key-domain helper) must derive them through the
+        # tenant-domain separator too, or its artifacts land in one
+        # shared namespace and the cryptographic isolation silently
+        # ends at this workload.  Kinds with no cache surface have
+        # nothing to scope and are exempt.
+        derives = any(_reaches_sanitizer(f, by_name, sanitizer_map,
+                                         want="key-domain",
+                                         class_methods=class_methods)
+                      for f in factories)
+        if derives and not any(
+                _reaches_sanitizer(f, by_name, sanitizer_map,
+                                   want="tenant-domain",
+                                   class_methods=class_methods)
+                for f in factories):
+            findings.append(Finding(
+                "taint-registry", site["relpath"], site["line"],
+                f"TaskType kind={kind!r}: derives cache keys without "
+                f"the sanitizes(tenant-domain) separator "
+                f"(tenancy/keys.py tenant_scoped_key) — artifacts "
+                f"would share one namespace across tenants"))
     return findings

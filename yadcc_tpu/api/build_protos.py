@@ -93,6 +93,24 @@ def _service(fd, name: str, *methods):
     return s
 
 
+def _env_desc_descriptor():
+    """env_desc.proto as a FileDescriptorProto — pure-maintained since
+    the tenancy ``tenant_scope`` field was added on a box without
+    protoc.  MUST stay field-for-field identical to protos/
+    env_desc.proto (the human-readable source of truth)."""
+    from google.protobuf import descriptor_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="env_desc.proto", package="ytpu.api", syntax="proto3")
+    _msg(fd, "EnvironmentDesc",
+         ("compiler_digest", 1, "string"),
+         # Tenant cache-domain secret set by the delegate daemon, so
+         # servant-side cache fills land in the submitting tenant's
+         # namespace (tenancy/keys.py); empty = legacy shared domain.
+         ("tenant_scope", 2, "string"))
+    return fd
+
+
 def _jit_descriptor():
     from google.protobuf import descriptor_pb2
 
@@ -219,7 +237,12 @@ def _scheduler_descriptor():
          ("immediate_reqs", 4, "uint32"),
          ("prefetch_reqs", 5, "uint32"),
          ("next_keep_alive_in_ms", 6, "uint32"),
-         ("min_version", 7, "uint32"))
+         ("min_version", 7, "uint32"),
+         # Multi-tenant QoS (doc/tenancy.md): the submitting tenant's
+         # HMAC credential ("ytpu-tn1.<id>.<mac>").  Verified
+         # fail-closed by SchedulerService when tenancy is enabled;
+         # empty on untenanted deployments.
+         ("tenant_credential", 8, "string"))
     _msg(fd, "WaitForStartingTaskResponse",
          ("grants", 1, ".ytpu.api.StartingTaskGrant", "repeated"),
          ("flow_control", 2, "uint32"),
@@ -276,6 +299,48 @@ def _scheduler_descriptor():
     _service(fd, "ReplicationService",
              ("Replicate", ".ytpu.api.ReplicateRequest",
               ".ytpu.api.ReplicateResponse"))
+    return fd
+
+
+def _cache_descriptor():
+    """cache.proto as a FileDescriptorProto; pure-maintained since the
+    tenant cache-quota status was added on a box without protoc.  MUST
+    stay field-for-field identical to protos/cache.proto (the
+    human-readable source of truth; lint's wire-drift rule checks)."""
+    from google.protobuf import descriptor_pb2
+
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="cache.proto", package="ytpu.api", syntax="proto3")
+    _enum(fd, "CacheStatus",
+          ("CACHE_STATUS_OK", 0),
+          ("CACHE_STATUS_NOT_FOUND", 1001),
+          ("CACHE_STATUS_ACCESS_DENIED", 1002),
+          ("CACHE_STATUS_INVALID_ARGUMENT", 1003),
+          # Tenant cache-bytes budget exhausted (doc/tenancy.md).
+          ("CACHE_STATUS_NO_QUOTA", 1004))
+    _msg(fd, "FetchBloomFilterRequest",
+         ("token", 1, "string"),
+         ("seconds_since_last_full_fetch", 2, "uint32"),
+         ("seconds_since_last_fetch", 3, "uint32"))
+    _msg(fd, "FetchBloomFilterResponse",
+         ("incremental", 1, "bool"),
+         ("newly_populated_keys", 2, "string", "repeated"),
+         ("num_hashes", 3, "uint32"))
+    _msg(fd, "TryGetEntryRequest",
+         ("token", 1, "string"),
+         ("key", 2, "string"))
+    _msg(fd, "TryGetEntryResponse")
+    _msg(fd, "PutEntryRequest",
+         ("token", 1, "string"),
+         ("key", 2, "string"))
+    _msg(fd, "PutEntryResponse")
+    _service(fd, "CacheService",
+             ("FetchBloomFilter", ".ytpu.api.FetchBloomFilterRequest",
+              ".ytpu.api.FetchBloomFilterResponse"),
+             ("TryGetEntry", ".ytpu.api.TryGetEntryRequest",
+              ".ytpu.api.TryGetEntryResponse"),
+             ("PutEntry", ".ytpu.api.PutEntryRequest",
+              ".ytpu.api.PutEntryResponse"))
     return fd
 
 
@@ -356,8 +421,10 @@ def _fanout_descriptor():
     return fd
 
 
-PURE_BUILDERS = {"jit.proto": _jit_descriptor,
+PURE_BUILDERS = {"env_desc.proto": _env_desc_descriptor,
+                 "jit.proto": _jit_descriptor,
                  "scheduler.proto": _scheduler_descriptor,
+                 "cache.proto": _cache_descriptor,
                  "fanout.proto": _fanout_descriptor}
 
 _PURE_TEMPLATE = '''\

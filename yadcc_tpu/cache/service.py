@@ -43,6 +43,8 @@ from ..common.token_verifier import TokenVerifier
 from ..rpc import RpcContext, RpcError, ServiceSpec
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
+from ..tenancy.budgets import CacheBytesLedger
+from ..tenancy.keys import key_namespace
 from .bloom_filter_generator import BloomFilterGenerator
 from .cache_engine import CacheEngine
 from .in_memory_cache import InMemoryCache
@@ -91,6 +93,7 @@ class CacheService:
         l1_ttl_s: float = DEFAULT_L1_TTL_S,
         l3_workers: int = 2,
         l3_pending_cap: int = DEFAULT_L3_PENDING_CAP,
+        tenant_bytes: Optional[CacheBytesLedger] = None,
     ):
         self.l1 = l1
         self.l2 = l2
@@ -108,6 +111,14 @@ class CacheService:
         self._clock = clock
         self._l2_hits = 0  # guarded by: self._lock
         self._fills = 0  # guarded by: self._lock
+        # Per-tenant cache-bytes write quotas (doc/tenancy.md), keyed by
+        # the PUBLIC namespace tag of scoped keys — this service holds
+        # no tenant secrets.  None = no quotas (every fill admitted).
+        self._tenant_bytes = tenant_bytes
+        # namespace tag -> {hits, fills, rejected_fills}; "" (legacy
+        # shared domain) is never tracked here.
+        self._stats_by_ns: dict[str, dict[str, int]] = \
+            {}  # guarded by: self._lock
         self._lock = threading.Lock()
         # client ip -> (last_fetch_time, last_full_fetch_time), one map
         # per served filter (region and fleet sync paces are independent).
@@ -303,6 +314,7 @@ class CacheService:
             self._schedule_l3_promote(req.key)
             self._note_tryget_reply(t0)
             raise RpcError(api.cache.CACHE_STATUS_NOT_FOUND, req.key)
+        self._bump_ns(key_namespace(req.key), "hits")
         ctx.response_attachment = value
         self._note_tryget_reply(t0)
         return api.cache.TryGetEntryResponse()
@@ -320,6 +332,17 @@ class CacheService:
         if len(attachment) > _MAX_ENTRY_BYTES:
             raise RpcError(api.cache.CACHE_STATUS_INVALID_ARGUMENT,
                            "entry too large")
+        ns = key_namespace(req.key)
+        if self._tenant_bytes is not None and not \
+                self._tenant_bytes.try_charge(ns, req.key, len(attachment)):
+            # Over the tenant's write quota: refuse the fill.  The
+            # compile still succeeded on the servant; only the cache
+            # byproduct is dropped, so the blast radius is a colder
+            # cache for the over-quota tenant alone.
+            self._bump_ns(ns, "rejected_fills")
+            raise RpcError(api.cache.CACHE_STATUS_NO_QUOTA,
+                           "tenant cache-bytes budget exhausted")
+        self._bump_ns(ns, "fills")
         self.l1.put(req.key, attachment)
         self.l2.put(req.key, attachment)
         self.bloom.add(req.key)
@@ -328,6 +351,14 @@ class CacheService:
         self._schedule_l3_writeback(req.key, attachment)
         logger.info("cache fill: %s (%d bytes)", req.key, len(attachment))
         return api.cache.PutEntryResponse()
+
+    def _bump_ns(self, namespace: str, counter: str) -> None:
+        if not namespace:
+            return
+        with self._lock:
+            per = self._stats_by_ns.setdefault(
+                namespace, {"hits": 0, "fills": 0, "rejected_fills": 0})
+            per[counter] += 1
 
     # -- L3 background tier --------------------------------------------------
 
@@ -451,7 +482,13 @@ class CacheService:
             }
             replies = self._tryget_replies
             reply_ms_max = self._tryget_reply_ms_max
+            stats_by_ns = {ns: dict(per)
+                           for ns, per in self._stats_by_ns.items()}
         out = {
+            # Per-tenant visibility keys on the public namespace tag of
+            # scoped keys (tenancy/keys.py key_namespace) — the tag
+            # identifies WHICH tenant without revealing any computation.
+            "stats_by_tenant": stats_by_ns,
             "l1": self.l1.stats(),
             "l2": {"engine": self.l2.name, **self.l2.stats()},
             "l2_hits": l2_hits,
@@ -469,4 +506,6 @@ class CacheService:
             if self.bloom_l3 is not None:
                 out["fleet_bloom_fill_ratio"] = round(
                     self.bloom_l3.fill_ratio(), 6)
+        if self._tenant_bytes is not None:
+            out["tenant_bytes"] = self._tenant_bytes.inspect()
         return out

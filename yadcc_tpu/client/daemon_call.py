@@ -13,7 +13,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from ..common.payload import Payload
-from .env_options import daemon_port
+from .env_options import daemon_port, tenant_token
 
 
 @dataclass
@@ -85,8 +85,13 @@ def _request_once(method: str, path: str, body, timeout_s: float):
     else:
         conn.timeout = timeout_s
         _bump("reuses")
-    conn.request(method, path, body=body or None,
-                 headers={"Content-Type": "application/octet-stream"})
+    headers = {"Content-Type": "application/octet-stream"}
+    cred = tenant_token()
+    if cred:
+        # Tenant credential (doc/tenancy.md): re-read per request so a
+        # window rotation mid-process picks up a refreshed credential.
+        headers["X-Ytpu-Tenant"] = cred
+    conn.request(method, path, body=body or None, headers=headers)
     resp = conn.getresponse()
     data = resp.read()
     return DaemonResponse(resp.status, data,

@@ -51,6 +51,11 @@ configuration is environment variables:
                            version-matching servant, timeout); 0 =
                            surface the failure instead (CI rigs that
                            must NOT mask a broken farm)
+    YTPU_TENANT_TOKEN      tenant credential (tenancy/identity.py),
+                           sent to the local daemon as the
+                           X-Ytpu-Tenant header on every request; empty
+                           = anonymous (rejected 403 by a daemon with
+                           tenancy enabled — doc/tenancy.md)
 """
 
 from __future__ import annotations
@@ -151,6 +156,13 @@ def jit_local_fallback() -> bool:
     miss (default).  Off = raise, for rigs that must not mask a broken
     farm behind silently-local compiles."""
     return _int_env("YTPU_JIT_LOCAL_FALLBACK", 1) == 1
+
+
+def tenant_token() -> str:
+    """YTPU_TENANT_TOKEN: the tenant credential presented to the local
+    daemon.  The credential identifies and authenticates; it carries no
+    cache secrets (those never reach clients — doc/tenancy.md)."""
+    return os.environ.get("YTPU_TENANT_TOKEN", "")
 
 
 def compress_level() -> int:

@@ -13,7 +13,12 @@ Layout:  b"YTC2" + u32 meta_len + CacheMeta-JSON + multi_chunk(files)
 where CacheMeta.entry_digest = digest(meta-sans-digest + body)
 
 Cache keys are derived from the task digest (reference :56-64), i.e.
-compiler + args + preprocessed source.
+compiler + args + preprocessed source.  Every key helper then routes
+through the tenant-domain separator (tenancy/keys.py): with a tenant
+secret the key is HMAC-scoped to that tenant's namespace (cross-tenant
+reads and poisons are cryptographically impossible); with the default
+empty secret the legacy key passes through byte-identical, which is
+what the dataplane parity gate and historical entries rely on.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from ..common.hashing import new_digest
 from ..common.multi_chunk import try_parse_multi_chunk_views
 from ..common.payload import Payload
 from ..common.hashing import digest_keyed
+from ..tenancy.keys import tenant_scoped_key
 from .task_digest import (
     get_aot_task_digest,
     get_autotune_task_digest,
@@ -75,44 +81,53 @@ class CacheEntry:
 
 
 def get_cache_key(compiler_digest: str, invocation_arguments: str,
-                  source_digest: str) -> str:  # ytpu: sanitizes(key-domain)
-    return _KEY_PREFIX + get_cxx_task_digest(
-        compiler_digest, invocation_arguments, source_digest)
+                  source_digest: str,
+                  tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
+    return tenant_scoped_key(tenant_secret, _KEY_PREFIX + get_cxx_task_digest(
+        compiler_digest, invocation_arguments, source_digest))
 
 
 def get_jit_cache_key(env_digest: str, compile_options: bytes,
-                      computation_digest: str) -> str:  # ytpu: sanitizes(key-domain)
-    return _JIT_KEY_PREFIX + get_jit_task_digest(
-        env_digest, compile_options, computation_digest)
+                      computation_digest: str,
+                      tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
+    return tenant_scoped_key(
+        tenant_secret, _JIT_KEY_PREFIX + get_jit_task_digest(
+            env_digest, compile_options, computation_digest))
 
 
 def get_aot_cache_key(env_digest: str, topology_digest: str,
-                      computation_digest: str) -> str:  # ytpu: sanitizes(key-domain)
+                      computation_digest: str,
+                      tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
     """One AOT child's executable: topology-tagged, so a resubmission
     that adds topologies re-reads the hits and compiles only the
     misses (partial-hit reuse, doc/workloads.md)."""
-    return _AOT_KEY_PREFIX + get_aot_task_digest(
-        env_digest, topology_digest, computation_digest)
+    return tenant_scoped_key(
+        tenant_secret, _AOT_KEY_PREFIX + get_aot_task_digest(
+            env_digest, topology_digest, computation_digest))
 
 
 def get_autotune_cache_key(env_digest: str, slice_digest: str,
-                           kernel_digest: str) -> str:  # ytpu: sanitizes(key-domain)
+                           kernel_digest: str,
+                           tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
     """One autotune child's slice-winner record."""
-    return _AUTOTUNE_KEY_PREFIX + get_autotune_task_digest(
-        env_digest, slice_digest, kernel_digest)
+    return tenant_scoped_key(
+        tenant_secret, _AUTOTUNE_KEY_PREFIX + get_autotune_task_digest(
+            env_digest, slice_digest, kernel_digest))
 
 
 def get_autotune_sweep_key(env_digest: str, space_digest: str,
-                           kernel_digest: str) -> str:  # ytpu: sanitizes(key-domain)
+                           kernel_digest: str,
+                           tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
     """The SWEEP-level winner record — (kernel digest, search-space
     digest, env digest) — filled by the delegate after the reduce, so
     a second host sweeping the identical space gets the final answer
     in one cache read with zero fan-out.  Domain-separated from the
     per-slice child keys: a slice record can never be read back as a
     sweep verdict."""
-    return _AUTOTUNE_KEY_PREFIX + digest_keyed(
-        "ytpu-autotune-sweep", env_digest.encode(),
-        space_digest.encode(), kernel_digest.encode())
+    return tenant_scoped_key(
+        tenant_secret, _AUTOTUNE_KEY_PREFIX + digest_keyed(
+            "ytpu-autotune-sweep", env_digest.encode(),
+            space_digest.encode(), kernel_digest.encode()))
 
 
 def write_cache_entry_payload(entry: CacheEntry) -> Payload:

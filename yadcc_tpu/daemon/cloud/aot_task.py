@@ -44,6 +44,8 @@ class CloudAotCompilationTask:
     claimed_computation_digest: str
     temp_root: str
     disallow_cache_fill: bool = False
+    # Tenant cache domain (env_desc.tenant_scope, doc/tenancy.md).
+    tenant_scope: str = ""
 
     computation_digest: str = ""
     workspace: Optional[TemporaryDir] = None
@@ -100,7 +102,8 @@ class CloudAotCompilationTask:
     @property
     def cache_key(self) -> str:
         return get_aot_cache_key(self.env_digest, self.topology_digest,
-                                 self.computation_digest)
+                                 self.computation_digest,
+                                 tenant_secret=self.tenant_scope)
 
     # -- completion ----------------------------------------------------------
 

@@ -46,6 +46,8 @@ class CloudAutotuneTask:
     claimed_kernel_digest: str
     temp_root: str
     disallow_cache_fill: bool = False
+    # Tenant cache domain (env_desc.tenant_scope, doc/tenancy.md).
+    tenant_scope: str = ""
 
     kernel_digest: str = ""
     workspace: Optional[TemporaryDir] = None
@@ -107,7 +109,8 @@ class CloudAutotuneTask:
     @property
     def cache_key(self) -> str:
         return get_autotune_cache_key(self.env_digest, self.slice_digest,
-                                      self.kernel_digest)
+                                      self.kernel_digest,
+                                      tenant_secret=self.tenant_scope)
 
     # -- completion ----------------------------------------------------------
 

@@ -122,6 +122,10 @@ class CloudCxxCompilationTask:
     temp_root: str
     disallow_cache_fill: bool = False
     ignore_timestamp_macros: bool = False
+    # Tenant cache domain (env_desc.tenant_scope, doc/tenancy.md): the
+    # servant's cache fill must land in the SUBMITTING tenant's
+    # namespace; "" = legacy shared domain.
+    tenant_scope: str = ""
 
     source: bytes = b""
     source_digest: str = ""
@@ -185,7 +189,8 @@ class CloudCxxCompilationTask:
     def cache_key(self) -> str:
         return get_cache_key(self.compiler_digest,
                              self.invocation_arguments,
-                             self.source_digest)
+                             self.source_digest,
+                             tenant_secret=self.tenant_scope)
 
     # -- completion ----------------------------------------------------------
 

@@ -11,6 +11,7 @@ rotating daemon-token window.
 
 from __future__ import annotations
 
+import hmac
 import threading
 import time
 from dataclasses import dataclass, field
@@ -146,8 +147,16 @@ class DaemonService:
         # An empty set must not accept-all — QueueCxxCompilationTask
         # ultimately runs caller-supplied command lines.
         with self._lock:
-            ok = bool(self._acceptable_tokens) and \
-                token in self._acceptable_tokens
+            candidates = sorted(self._acceptable_tokens)
+        # Timing-safe sweep: compare against EVERY candidate with
+        # hmac.compare_digest and no early exit, so response timing
+        # reveals neither a prefix match nor which window position
+        # matched (the old set-membership probe hashed the attacker's
+        # guess, whose comparison cost leaks on collision probing).
+        ok = False
+        for candidate in candidates:
+            if hmac.compare_digest(token, candidate):
+                ok = True
         if not ok:
             raise RpcError(api.daemon.DAEMON_STATUS_ACCESS_DENIED,
                            "unrecognized daemon token")
@@ -179,6 +188,7 @@ class DaemonService:
             temp_root=self.config.temporary_dir,
             disallow_cache_fill=req.disallow_cache_fill,
             ignore_timestamp_macros=req.ignore_timestamp_macros,
+            tenant_scope=req.env_desc.tenant_scope,
         )
         try:
             try:
@@ -337,6 +347,7 @@ class DaemonService:
             claimed_computation_digest=req.computation_digest,
             temp_root=self.config.temporary_dir,
             disallow_cache_fill=req.disallow_cache_fill,
+            tenant_scope=req.env_desc.tenant_scope,
         )
         task_id = self._queue_worker_task(task, req.task_grant_id,
                                           attachment)
@@ -362,6 +373,7 @@ class DaemonService:
             claimed_computation_digest=req.computation_digest,
             temp_root=self.config.temporary_dir,
             disallow_cache_fill=req.disallow_cache_fill,
+            tenant_scope=req.env_desc.tenant_scope,
         )
         task_id = self._queue_worker_task(task, req.task_grant_id,
                                           attachment)
@@ -382,6 +394,7 @@ class DaemonService:
             claimed_kernel_digest=req.kernel_digest,
             temp_root=self.config.temporary_dir,
             disallow_cache_fill=req.disallow_cache_fill,
+            tenant_scope=req.env_desc.tenant_scope,
         )
         task_id = self._queue_worker_task(task, req.task_grant_id,
                                           attachment)

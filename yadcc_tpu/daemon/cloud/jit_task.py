@@ -88,6 +88,8 @@ class CloudJitCompilationTask:
     claimed_computation_digest: str
     temp_root: str
     disallow_cache_fill: bool = False
+    # Tenant cache domain (env_desc.tenant_scope, doc/tenancy.md).
+    tenant_scope: str = ""
 
     computation_digest: str = ""
     workspace: Optional[TemporaryDir] = None
@@ -133,7 +135,8 @@ class CloudJitCompilationTask:
     @property
     def cache_key(self) -> str:
         return get_jit_cache_key(self.env_digest, self.compile_options,
-                                 self.computation_digest)
+                                 self.computation_digest,
+                                 tenant_secret=self.tenant_scope)
 
     # -- completion ----------------------------------------------------------
 

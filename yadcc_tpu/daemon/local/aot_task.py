@@ -65,7 +65,8 @@ class AotTopologyCompilationTask(DistributedTask):
         if self.get_cache_setting() == self.CACHE_DISALLOW:
             return None
         return get_aot_cache_key(self.env_digest, self.topology.digest(),
-                                 self.computation_digest)
+                                 self.computation_digest,
+                                 tenant_secret=self.tenant_key_secret)
 
     def get_digest(self) -> str:
         return get_aot_task_digest(self.env_digest,
@@ -85,6 +86,7 @@ class AotTopologyCompilationTask(DistributedTask):
             disallow_cache_fill=self.cache_control <= 0,
         )
         req.env_desc.compiler_digest = self.env_digest
+        req.env_desc.tenant_scope = self.tenant_key_secret
         req.topology.mesh_shape.extend(self.topology.mesh_shape)
         req.topology.device_count = self.topology.device_count
         req.topology.compile_options = bytes(

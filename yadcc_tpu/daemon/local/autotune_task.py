@@ -98,7 +98,8 @@ class AutotuneSliceTask(DistributedTask):
         if self.get_cache_setting() == self.CACHE_DISALLOW:
             return None
         return get_autotune_cache_key(self.env_digest, self.slice_digest,
-                                      self.kernel_digest)
+                                      self.kernel_digest,
+                                      tenant_secret=self.tenant_key_secret)
 
     def get_digest(self) -> str:
         return get_autotune_task_digest(self.env_digest,
@@ -118,6 +119,7 @@ class AutotuneSliceTask(DistributedTask):
             disallow_cache_fill=self.cache_control <= 0,
         )
         req.env_desc.compiler_digest = self.env_digest
+        req.env_desc.tenant_scope = self.tenant_key_secret
         req.configs.extend(self.configs)
         resp, _ = channel.call(
             "ytpu.DaemonService", "QueueAutotuneTask", req,
@@ -184,7 +186,8 @@ class AutotuneSweepTask(DistributedTask):
         if self.get_cache_setting() == self.CACHE_DISALLOW:
             return None
         return get_autotune_sweep_key(self.env_digest, self.space_digest,
-                                      self.kernel_digest)
+                                      self.kernel_digest,
+                                      tenant_secret=self.tenant_key_secret)
 
     def get_digest(self) -> str:
         return get_autotune_task_digest(self.env_digest,

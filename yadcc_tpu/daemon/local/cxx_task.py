@@ -56,7 +56,8 @@ class CxxCompilationTask(DistributedTask):
             return None
         return get_cache_key(self.compiler_digest,
                              self.invocation_arguments,
-                             self.source_digest)
+                             self.source_digest,
+                             tenant_secret=self.tenant_key_secret)
 
     def get_digest(self) -> str:
         return get_cxx_task_digest(self.compiler_digest,
@@ -77,6 +78,10 @@ class CxxCompilationTask(DistributedTask):
             ignore_timestamp_macros=self.ignore_timestamp_macros,
         )
         req.env_desc.compiler_digest = self.compiler_digest
+        # The servant derives the fill key in the same tenant domain
+        # (env_desc.tenant_scope rides the daemon-token-authenticated
+        # delegate->servant channel; doc/tenancy.md).
+        req.env_desc.tenant_scope = self.tenant_key_secret
         resp, _ = channel.call(
             "ytpu.DaemonService", "QueueCxxCompilationTask", req,
             api.daemon.QueueCxxCompilationTaskResponse,

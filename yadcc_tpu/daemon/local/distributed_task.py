@@ -55,11 +55,34 @@ class DistributedTask:
     # a pid, or a lower weight for bulk background work).
     fairness_weight = 1.0
 
+    # Verified tenant identity (doc/tenancy.md), stamped onto INSTANCES
+    # by the delegate HTTP surface after credential verification — never
+    # taken from the request body.  Class-level defaults are the
+    # single-tenant/legacy mode: no tenant, shared cache domain, full
+    # fairness weight at the (degenerate, single-entry) tenant level.
+    tenant_id = ""
+    tenant_tier = ""
+    tenant_key_secret = ""
+    tenant_weight = 1.0
+    # Fan-out width cap for this submission (0 = global default);
+    # derived from the tenant's tier/spec at the HTTP surface.
+    tenant_fanout_cap = 0
+
     def fairness_key(self) -> str:
         """Requestor identity for fair grant hand-out.  Default: the
         submitting process — every implementation exposes
-        ``requestor_pid`` (it already must, for the orphan-kill timer)."""
+        ``requestor_pid`` (it already must, for the orphan-kill timer).
+        With tenancy enabled this is the WITHIN-tenant key; the tenant
+        level above it is ``fairness_tenant()`` (two-level stride,
+        daemon/local/fair_admission.py)."""
         return str(getattr(self, "requestor_pid", 0))
+
+    def fairness_tenant(self) -> str:
+        """Tenant identity for the outer stride level; "" = the shared
+        legacy tenant.  A bare PID collides across hosts once delegates
+        multiplex tenants — the tenant id disambiguates, and the PID
+        stays meaningful as the within-tenant key."""
+        return self.tenant_id
 
     # Cache policy (reference distributed_task.h:36 CacheControl):
     CACHE_DISALLOW = 0  # never read, never fill

@@ -31,6 +31,7 @@ from typing import Dict, Optional
 from ... import api
 from ...jit import fanout
 from ...rpc import Channel, RpcError
+from ...tenancy import TenantLedger, TenantOverBudget
 from ...utils.logging import get_logger
 from .config_keeper import ConfigKeeper
 from .distributed_cache_reader import DistributedCacheReader
@@ -87,6 +88,12 @@ class DistributedTaskDispatcher:
         # locations are bare host:port).  "aio://" when the fleet runs
         # the event-loop front end (--rpc-frontend aio).
         servant_scheme: str = "grpc://",
+        # Delegate-side per-tenant budget ledger (doc/tenancy.md): an
+        # over-budget tenant's submission is refused AT THE DOOR
+        # (queue_task raises TenantOverBudget -> HTTP 503 +
+        # Retry-After) instead of occupying a task thread.  None =
+        # unbudgeted (single-tenant deployments).
+        tenant_ledger: Optional[TenantLedger] = None,
     ):
         self._grants = grant_keeper
         self._config = config_keeper
@@ -103,6 +110,7 @@ class DistributedTaskDispatcher:
         # every servant dial goes HERE; grants still flow normally.
         self._debug_servant = debugging_always_use_servant_at
         self._servant_scheme = servant_scheme
+        self._tenant_ledger = tenant_ledger
         self._lock = threading.Lock()
         self._tasks: Dict[int, _Entry] = {}  # guarded by: self._lock
         self._next_id = 1  # guarded by: self._lock
@@ -114,6 +122,10 @@ class DistributedTaskDispatcher:
         # aggregate above is the long-standing public surface, the
         # split is what a mixed-workload deployment actually watches.
         self.stats_by_kind: Dict[str, Dict[str, int]] = {}  # guarded by: self._lock
+        # And split per tenant ("" entries are never created): the
+        # noisy-neighbor scenario reads victim/adversary provenance
+        # from here.
+        self.stats_by_tenant: Dict[str, Dict[str, int]] = {}  # guarded by: self._lock
 
     # -- public API ----------------------------------------------------------
 
@@ -128,6 +140,14 @@ class DistributedTaskDispatcher:
             self._cache.stop()
 
     def queue_task(self, task: DistributedTask) -> int:
+        tenant = task.fairness_tenant()
+        if self._tenant_ledger is not None and tenant:
+            # Budget check at the door: an over-budget tenant is
+            # refused before a task thread or queue slot exists, so
+            # its refused demand is invisible to everyone else.
+            if self._tenant_ledger.over_budget(tenant, want_immediate=1):
+                raise TenantOverBudget(tenant)
+            self._tenant_ledger.charge(tenant)
         with self._lock:
             entry = _Entry(task_id=self._next_id, task=task)
             self._next_id += 1
@@ -138,12 +158,17 @@ class DistributedTaskDispatcher:
         ).start()
         return entry.task_id
 
-    def _bump_locked(self, kind: str, counter: str) -> None:
+    def _bump_locked(self, kind: str, counter: str,
+                     tenant: str = "") -> None:
         """Increment a provenance counter; caller holds self._lock."""
         self.stats[counter] += 1
         per = self.stats_by_kind.setdefault(
             kind, {k: 0 for k in self.stats})
         per[counter] += 1
+        if tenant:
+            pt = self.stats_by_tenant.setdefault(
+                tenant, {k: 0 for k in self.stats})
+            pt[counter] += 1
 
     def wait_for_task(self, task_id: int,
                       timeout_s: float) -> Optional[TaskResult]:
@@ -200,8 +225,17 @@ class DistributedTaskDispatcher:
             # Counter updates take the lock: one TU thread runs per
             # in-flight task, and dict `+=` is a read-modify-write that
             # loses increments when two of them interleave.
+            # The failure path must not raise: the task object that just
+            # blew up may not implement the full SPI, and an exception
+            # here would leave the waiter hanging after all.
+            tenant = getattr(entry.task, "fairness_tenant", lambda: "")()
             with self._lock:
-                self._bump_locked(entry.task.kind, "failed")
+                self._bump_locked(entry.task.kind, "failed", tenant)
+        if self._tenant_ledger is not None:
+            # Every exit path lands here (the try/except above never
+            # re-raises), so the outstanding count is exact.
+            self._tenant_ledger.release(
+                getattr(entry.task, "fairness_tenant", lambda: "")())
         with self._lock:
             entry.result = result
             entry.state = TaskState.DONE
@@ -234,7 +268,8 @@ class DistributedTaskDispatcher:
             logger.warning("corrupted cache entry for %s", key)
             return None
         with self._lock:
-            self._bump_locked(entry.task.kind, "hit_cache")
+            self._bump_locked(entry.task.kind, "hit_cache",
+                              entry.task.fairness_tenant())
         return result
 
     def _perform_fanout(self, entry: _Entry) -> TaskResult:
@@ -249,6 +284,12 @@ class DistributedTaskDispatcher:
         (that is what makes partial hits provable via
         ``actually_run``); the parent bumps nothing on success."""
         children = entry.task.expand_children()
+        if entry.task.tenant_fanout_cap:
+            # Tier fan-out cap (doc/tenancy.md): a best_effort tenant's
+            # sweep may not expand wider than its tier allows, however
+            # generous the global YTPU_FANOUT_MAX_WIDTH bound is.
+            fanout.checked_fanout_width(
+                len(children), cap=entry.task.tenant_fanout_cap)
         outcomes = fanout.run_fanout(
             children,
             queue=self.queue_task,
@@ -304,20 +345,24 @@ class DistributedTaskDispatcher:
             # counter): fan-out verdicts report "joined" from it.
             result.reused_existing = True
             with self._lock:
-                self._bump_locked(entry.task.kind, "reused")
+                self._bump_locked(entry.task.kind, "reused",
+                                  entry.task.fairness_tenant())
         return result
 
     def _start_new_servant_task(self, entry: _Entry) -> TaskResult:
         grant = self._grants.get(entry.task.get_env_digest(), timeout_s=10.0,
                                  client_key=entry.task.fairness_key(),
-                                 weight=entry.task.fairness_weight)
+                                 weight=entry.task.fairness_weight,
+                                 tenant=entry.task.fairness_tenant(),
+                                 tenant_weight=entry.task.tenant_weight)
         if grant is None:
             if self._grants.local_only_active():
                 # Explicit overload-ladder verdict, not a timeout: the
                 # scheduler told this box to use its own CPU.  Count it
                 # so a fleet shedding load is visible in /inspect.
                 with self._lock:
-                    self._bump_locked(entry.task.kind, "shed_to_local")
+                    self._bump_locked(entry.task.kind, "shed_to_local",
+                                      entry.task.fairness_tenant())
                 return TaskResult(
                     exit_code=-1,
                     standard_error=b"cluster overloaded (LOCAL_ONLY "
@@ -349,7 +394,8 @@ class DistributedTaskDispatcher:
                 standard_error=b"servant lost while compiling")
         else:
             with self._lock:
-                self._bump_locked(entry.task.kind, "actually_run")
+                self._bump_locked(entry.task.kind, "actually_run",
+                                  entry.task.fairness_tenant())
         return result
 
     def _wait_servant(self, entry: _Entry,
@@ -435,7 +481,7 @@ class DistributedTaskDispatcher:
 
     def inspect(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "in_flight": sum(1 for e in self._tasks.values()
                                  if e.state != TaskState.DONE),
                 "retained": sum(1 for e in self._tasks.values()
@@ -443,7 +489,12 @@ class DistributedTaskDispatcher:
                 "stats": dict(self.stats),
                 "stats_by_kind": {k: dict(v) for k, v
                                   in self.stats_by_kind.items()},
+                "stats_by_tenant": {k: dict(v) for k, v
+                                    in self.stats_by_tenant.items()},
             }
+        if self._tenant_ledger is not None:
+            out["tenant_budgets"] = self._tenant_ledger.inspect()
+        return out
 
 
 def _default_pid_alive(pid: int) -> bool:

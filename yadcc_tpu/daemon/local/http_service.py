@@ -38,6 +38,9 @@ from ...common import multi_chunk
 from ...common.hashing import digest_keyed
 from ...common.limits import BodyTooLarge, checked_content_length, clamp_wait_s
 from ...common.payload import Payload
+from ...tenancy.budgets import TenantOverBudget
+from ...tenancy.keys import tenant_scoped_key
+from ...tenancy.tiers import tier_fanout_cap
 from ...utils.logging import get_logger
 from ...version import BUILT_AT, VERSION_FOR_UPGRADE
 from .distributed_task_dispatcher import DistributedTaskDispatcher
@@ -54,9 +57,21 @@ _SHIM_KEY_PREFIX = "ytpu-jitext1-"
 _SHIM_KEY_DOMAIN = "ytpu-jit-extcache"
 
 
-def shim_cache_key(client_key: str) -> str:  # ytpu: sanitizes(key-domain)
-    return _SHIM_KEY_PREFIX + digest_keyed(_SHIM_KEY_DOMAIN,
-                                           client_key.encode())
+def shim_cache_key(client_key: str,
+                   tenant_secret: str = "") -> str:  # ytpu: sanitizes(key-domain, tenant-domain)
+    return tenant_scoped_key(
+        tenant_secret,
+        _SHIM_KEY_PREFIX + digest_keyed(_SHIM_KEY_DOMAIN,
+                                        client_key.encode()))
+
+
+# Sentinel distinct from None: None = tenancy disabled (anonymous OK),
+# _TENANT_DENIED = tenancy enabled and this request failed verification.
+_TENANT_DENIED = object()
+_TENANT_HEADER = "x-ytpu-tenant"
+_DENIED_BODY = b'{"error":"valid tenant credential required"}'
+
+_DENIED_BUDGET_BODY = b'{"error":"tenant over budget"}'
 
 
 def _to_json(msg) -> bytes:
@@ -96,6 +111,12 @@ class LocalHttpService:
         # wait_for_*) park as continuations + a loop timer instead of a
         # serving thread each (doc/daemon.md "RPC front end").
         frontend: str = "threaded",
+        # Multi-tenant QoS (doc/tenancy.md): a tenancy.TenancyControl
+        # makes every POST route fail-closed on the X-Ytpu-Tenant
+        # credential; the verified binding is stamped onto tasks (tier
+        # fan-out caps, tenant-weighted fairness, tenant cache domain).
+        # None (default) = single-tenant mode, behavior unchanged.
+        tenancy=None,
     ):
         self.monitor = monitor
         self.digest_cache = digest_cache
@@ -105,6 +126,7 @@ class LocalHttpService:
         self.cache_reader = cache_reader
         self.cache_writer = cache_writer
         self.frontend = frontend
+        self.tenancy = tenancy
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -196,9 +218,29 @@ class LocalHttpService:
         self._httpd.server_close()
 
     def inspect(self) -> dict:
-        if self._aio is not None:
-            return self._aio.inspect()
-        return {"frontend": "threaded", "port": self.port}
+        out = ({"frontend": "threaded", "port": self.port}
+               if self._aio is None else self._aio.inspect())
+        if self.tenancy is not None:
+            out["tenancy"] = self.tenancy.inspect()
+        return out
+
+    # -- tenant verification (both front ends) -------------------------------
+
+    def _tenant_binding(self, headers):
+        """Resolve the request's tenant from its headers: a
+        TenantBinding, None (tenancy disabled — anonymous requests keep
+        their legacy behavior), or _TENANT_DENIED (tenancy enabled,
+        credential missing/invalid/unknown — the caller must 403).
+        Fail-closed: with tenancy on, there is no anonymous path to any
+        POST route.  Takes the header mapping, not the responder: this
+        helper only reads, it never replies."""
+        if self.tenancy is None:
+            return None
+        # Works on both header shapes: the threaded front end's
+        # case-insensitive Message and the aio dict (lower-cased keys).
+        cred = headers.get(_TENANT_HEADER, "") if headers else ""
+        binding = self.tenancy.authenticate(cred)
+        return binding if binding is not None else _TENANT_DENIED
 
     # -- aio front end (event-loop routing) ----------------------------------
 
@@ -219,6 +261,14 @@ class LocalHttpService:
             return
         if responder.method != "POST":
             responder._reply(501)
+            return
+        # Tenant check BEFORE parking: parked routes drop their headers
+        # (release_request), so this is the one place the credential
+        # exists.  Pooled routes re-resolve in _route_post (headers are
+        # kept), which also stamps the binding onto submitted tasks.
+        if self._tenant_binding(getattr(responder, "headers", None)) \
+                is _TENANT_DENIED:
+            responder._reply(403, _DENIED_BODY)
             return
         path, body = responder.path, responder.request.body
         if path == "/local/acquire_quota":
@@ -323,6 +373,11 @@ class LocalHttpService:
     # -- routing -------------------------------------------------------------
 
     def _route_post(self, handler, path: str, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
+        binding = self._tenant_binding(
+            getattr(handler, "headers", None))
+        if binding is _TENANT_DENIED:
+            handler._reply(403, _DENIED_BODY)
+            return
         if path == "/local/ask_to_leave":
             handler._reply(200, _to_json(api.local.AskToLeaveResponse()))
             self.on_leave()
@@ -357,14 +412,14 @@ class LocalHttpService:
             handler._reply(200, _to_json(api.local.SetFileDigestResponse()))
             return
         if path == "/local/jit_cache_get":
-            self._jit_cache_get(handler, body)
+            self._jit_cache_get(handler, body, binding)
             return
         if path == "/local/jit_cache_put":
-            self._jit_cache_put(handler, body)
+            self._jit_cache_put(handler, body, binding)
             return
         task_type = self.registry.for_submit(path)
         if task_type is not None:
-            self._submit_task(handler, task_type, body)
+            self._submit_task(handler, task_type, body, binding)
             return
         task_type = self.registry.for_wait(path)
         if task_type is not None:
@@ -374,7 +429,8 @@ class LocalHttpService:
 
     # -- generic task submit/wait (one flow for every registered kind) -------
 
-    def _submit_task(self, handler, task_type, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
+    def _submit_task(self, handler, task_type, body: bytes,
+                     binding=None) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         # Views: the (possibly multi-MB) attachment chunk stays a view
         # into the request body all the way to the servant RPC.
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
@@ -390,7 +446,25 @@ class LocalHttpService:
                 raise
             handler._reply(400, err)
             return
-        task_id = self.dispatcher.queue_task(task)
+        if binding is not None:
+            # Instance-level stamp of the VERIFIED identity (never the
+            # request body): cache domain, two-level fairness, tier
+            # fan-out rights (doc/tenancy.md).
+            task.tenant_id = binding.tenant_id
+            task.tenant_tier = binding.tier
+            task.tenant_key_secret = binding.key_secret
+            task.tenant_weight = binding.weight
+            task.tenant_fanout_cap = (binding.spec.fanout_cap
+                                      or tier_fanout_cap(binding.tier))
+        try:
+            task_id = self.dispatcher.queue_task(task)
+        except TenantOverBudget as e:
+            # Budget refusal is backpressure, not an error: same 503 +
+            # Retry-After contract the quota and long-poll routes use,
+            # so existing client backoff handles it unchanged.
+            handler._reply(503, _DENIED_BUDGET_BODY,
+                           retry_after_s=e.retry_after_ms / 1000.0)
+            return
         # Every submit response is {task_id}; the registered response
         # classes share the field by convention.
         handler._reply(200, _to_json(
@@ -416,12 +490,14 @@ class LocalHttpService:
 
     # -- persistent-compile-cache shim routes --------------------------------
 
-    def _jit_cache_get(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
+    def _jit_cache_get(self, handler, body: bytes,
+                       binding=None) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         req = _from_json(api.jit.JitCacheGetRequest, body)
         if self.cache_reader is None or not req.key:
             handler._reply(404)
             return
-        data = self.cache_reader.try_read(shim_cache_key(req.key))
+        secret = binding.key_secret if binding is not None else ""
+        data = self.cache_reader.try_read(shim_cache_key(req.key, secret))
         if data is None:
             handler._reply(404)
             return
@@ -431,7 +507,8 @@ class LocalHttpService:
                 [_to_json(api.jit.JitCacheGetResponse()), data]),
             content_type="application/octet-stream")
 
-    def _jit_cache_put(self, handler, body: bytes) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
+    def _jit_cache_put(self, handler, body: bytes,
+                       binding=None) -> None:  # ytpu: untrusted(body)  # ytpu: responder(handler)
         chunks = multi_chunk.try_parse_multi_chunk_views(body)
         if not chunks or len(chunks) != 2:
             handler._reply(400, b'{"error":"expect json+value chunks"}')
@@ -440,6 +517,7 @@ class LocalHttpService:
         if self.cache_writer is None or not req.key:
             handler._reply(404)
             return
-        self.cache_writer.async_write(shim_cache_key(req.key),
+        secret = binding.key_secret if binding is not None else ""
+        self.cache_writer.async_write(shim_cache_key(req.key, secret),
                                       bytes(chunks[1]))
         handler._reply(200, _to_json(api.jit.JitCachePutResponse()))

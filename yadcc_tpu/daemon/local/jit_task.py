@@ -58,7 +58,8 @@ class JitCompilationTask(DistributedTask):
         if self.get_cache_setting() == self.CACHE_DISALLOW:
             return None
         return get_jit_cache_key(self.env_digest, self.compile_options,
-                                 self.computation_digest)
+                                 self.computation_digest,
+                                 tenant_secret=self.tenant_key_secret)
 
     def get_digest(self) -> str:
         return get_jit_task_digest(self.env_digest, self.compile_options,
@@ -78,6 +79,7 @@ class JitCompilationTask(DistributedTask):
             disallow_cache_fill=self.cache_control <= 0,
         )
         req.env_desc.compiler_digest = self.env_digest
+        req.env_desc.tenant_scope = self.tenant_key_secret
         resp, _ = channel.call(
             "ytpu.DaemonService", "QueueJitCompilationTask", req,
             api.jit.QueueJitCompilationTaskResponse,

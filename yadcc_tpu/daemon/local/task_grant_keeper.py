@@ -51,9 +51,16 @@ class Grant:
 
 
 class _EnvFetcher:
-    def __init__(self, keeper: "TaskGrantKeeper", env_digest: str):
+    def __init__(self, keeper: "TaskGrantKeeper", env_digest: str,
+                 tenant: str = ""):
         self.keeper = keeper
         self.env_digest = env_digest
+        # Multi-tenant QoS (doc/tenancy.md): fetchers are keyed by
+        # (env, tenant) so each fetch carries exactly ONE tenant's
+        # credential and the scheduler's per-tenant ledger attributes
+        # every minted grant to the tenant that asked — a shared
+        # fetcher would launder all demand under one identity.
+        self.tenant = tenant
         # Weighted-fair hand-out keyed by requestor: one make -j500
         # must not starve the other clients on this box
         # (doc/robustness.md, "Fairness quotas").
@@ -69,7 +76,8 @@ class _EnvFetcher:
         self.thread.start()
 
     def get(self, timeout_s: float, client_key: str = "",
-            weight: float = 1.0) -> Optional[Grant]:
+            weight: float = 1.0, tenant: str = "",
+            tenant_weight: float = 1.0) -> Optional[Grant]:
         deadline = time.monotonic() + timeout_s
         with self.lock:
             self.waiters += 1
@@ -91,7 +99,9 @@ class _EnvFetcher:
                 if remaining <= 0:
                     return None
                 g = self.queue.get(client_key, weight,
-                                   timeout_s=min(remaining, 0.5))
+                                   timeout_s=min(remaining, 0.5),
+                                   tenant=tenant,
+                                   tenant_weight=tenant_weight)
                 if g is None:
                     self.wake.set()  # fetcher may have gone idle
                     continue
@@ -144,7 +154,8 @@ class _EnvFetcher:
                 continue  # queued grants already cover the demand
             immediate = waiters - backlog
             grants, flow, retry_after_s = self.keeper._fetch(
-                self.env_digest, immediate, prefetch=1)
+                self.env_digest, immediate, prefetch=1,
+                tenant=self.tenant)
             now = time.monotonic()
             for gid, location in grants:
                 self.queue.put(Grant(
@@ -178,7 +189,8 @@ class TaskGrantKeeper:
     IDLE_FETCHER_TTL_S = 600.0
 
     def __init__(self, scheduler_uri: str, token: str,
-                 min_version: int = 0):
+                 min_version: int = 0,
+                 tenant_credential_fn=None):
         # Multi-cell federation (doc/scheduler.md "Federation"):
         # ``scheduler_uri`` is ";"-separated cell groups, each group a
         # comma-separated active,standby failover list (the comma form
@@ -197,6 +209,11 @@ class TaskGrantKeeper:
             if len(self._cell_uris) > 1 else None)
         self._token = token
         self._min_version = min_version
+        # tenant_id -> credential minting callable (typically
+        # TenancyControl.credential_for).  None on untenanted
+        # deployments; fetches then never set tenant_credential and the
+        # wire stays byte-identical to the legacy form.
+        self._tenant_credential_fn = tenant_credential_fn
         self._lock = threading.Lock()
         self._fetchers: Dict[str, _EnvFetcher] = {}  # guarded by: self._lock
         self._stopping = threading.Event()
@@ -206,32 +223,41 @@ class TaskGrantKeeper:
         self._flow: Tuple[int, float] = (0, 0.0)  # guarded by: self._lock
 
     def get(self, env_digest: str, timeout_s: float = 10.0,
-            client_key: str = "", weight: float = 1.0) -> Optional[Grant]:
+            client_key: str = "", weight: float = 1.0,
+            tenant: str = "", tenant_weight: float = 1.0
+            ) -> Optional[Grant]:
         """One grant for ``env_digest``, or None.  ``client_key``
         identifies the requestor for weighted-fair hand-out (empty =
-        shared anonymous client); under an active compile-locally
-        verdict this returns None immediately so the caller's local
-        fallback starts now."""
+        shared anonymous client); ``tenant`` selects the outer stride
+        level of the two-level queue (doc/tenancy.md; empty = shared
+        legacy tenant).  Under an active compile-locally verdict this
+        returns None immediately so the caller's local fallback starts
+        now."""
         if self.local_only_active():
             return None
         now = time.monotonic()
+        # Fetchers are keyed (env, tenant) so each carries one tenant's
+        # credential; "\x00" cannot appear in a hex digest, so the
+        # legacy tenant-less key space is untouched.
+        fkey = env_digest if not tenant else f"{env_digest}\x00{tenant}"
         retire = []
         with self._lock:
-            for digest, f in list(self._fetchers.items()):
-                if (digest != env_digest and f.waiters == 0
+            for key, f in list(self._fetchers.items()):
+                if (key != fkey and f.waiters == 0
                         and now - f.last_used > self.IDLE_FETCHER_TTL_S):
-                    retire.append(self._fetchers.pop(digest))
-            f = self._fetchers.get(env_digest)
+                    retire.append(self._fetchers.pop(key))
+            f = self._fetchers.get(fkey)
             if f is None or f.retired.is_set():
-                f = _EnvFetcher(self, env_digest)
-                self._fetchers[env_digest] = f
+                f = _EnvFetcher(self, env_digest, tenant=tenant)
+                self._fetchers[fkey] = f
             # Refresh under the keeper lock: the idle scan above runs
             # under the same lock, so a fetcher handed out here can
             # never be judged stale before its waiter registers.
             f.last_used = now
         for r in retire:
             r.retire()
-        return f.get(timeout_s, client_key=client_key, weight=weight)
+        return f.get(timeout_s, client_key=client_key, weight=weight,
+                     tenant=tenant, tenant_weight=tenant_weight)
 
     # -- flow-control verdict state (overload ladder) ------------------------
 
@@ -308,7 +334,8 @@ class TaskGrantKeeper:
                 ch = self._channels[cell] = Channel(self._cell_uris[cell])
             return ch
 
-    def _fetch(self, env_digest: str, immediate: int, prefetch: int):
+    def _fetch(self, env_digest: str, immediate: int, prefetch: int,
+               tenant: str = ""):
         """One grant poll.  Returns (grants, flow_verdict,
         retry_after_s): flow_verdict is the scheduler's overload-ladder
         answer (FlowControlVerdict value, 0 = none) and retry_after_s
@@ -322,6 +349,15 @@ class TaskGrantKeeper:
             min_version=self._min_version,
         )
         req.env_desc.compiler_digest = env_digest
+        if tenant and self._tenant_credential_fn is not None:
+            try:
+                req.tenant_credential = self._tenant_credential_fn(tenant)
+            except Exception:
+                # No mintable window token right now: send no
+                # credential and let the scheduler fail closed rather
+                # than killing the fetch loop.
+                logger.warning("could not mint credential for tenant %r",
+                               tenant)
         try:
             resp, _ = self._chan(env_digest).call(
                 "ytpu.SchedulerService", "WaitForStartingTask", req,

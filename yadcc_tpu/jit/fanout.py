@@ -220,12 +220,23 @@ def split_fairness(parent, children: Sequence[object]) -> None:
     """Children inherit the parent requestor's fairness key (they
     already share its ``requestor_pid``) and split its weight: the
     whole fan-out draws one submission's share of grants, however wide
-    it is.  Weights land on the instances, not the class."""
+    it is.  Weights land on the instances, not the class.
+
+    Tenant identity (doc/tenancy.md) is inherited wholesale: a child
+    compiles, queues, and caches AS its parent's tenant — children that
+    fell back to the class-default empty tenant would read and fill the
+    SHARED cache domain, silently undoing the isolation the parent's
+    submission was granted."""
     if not children:
         return
     share = getattr(parent, "fairness_weight", 1.0) / len(children)
     for child in children:
         child.fairness_weight = share
+        child.tenant_id = getattr(parent, "tenant_id", "")
+        child.tenant_tier = getattr(parent, "tenant_tier", "")
+        child.tenant_key_secret = getattr(parent, "tenant_key_secret", "")
+        child.tenant_weight = getattr(parent, "tenant_weight", 1.0)
+        child.tenant_fanout_cap = getattr(parent, "tenant_fanout_cap", 0)
 
 
 def _classify(result) -> Tuple[str, int, str]:

@@ -359,12 +359,16 @@ class FederationRouter:
 
     def admission_check(self, immediate: int = 1, prefetch: int = 0,
                         requestor: str = "",
-                        home: Optional[int] = None) -> AdmissionDecision:
+                        home: Optional[int] = None,
+                        tenant: str = "",
+                        tier: str = "") -> AdmissionDecision:
         local = self._local()
         if getattr(local, "resolve_home", None) is not None:
             return local.admission_check(immediate, prefetch, requestor,
-                                         home=home)
-        return local.admission_check(immediate, prefetch, requestor)
+                                         home=home, tenant=tenant,
+                                         tier=tier)
+        return local.admission_check(immediate, prefetch, requestor,
+                                     tenant=tenant, tier=tier)
 
     # -- the grant path ------------------------------------------------------
 
@@ -375,11 +379,12 @@ class FederationRouter:
                                    prefetch: int = 0,
                                    lease_s: float = 15.0,
                                    timeout_s: float = 5.0,
+                                   tenant: str = "",
                                    ) -> List[Tuple[int, str]]:
         return self.wait_for_starting_new_task_routed(
             env_digest, min_version=min_version, requestor=requestor,
             immediate=immediate, prefetch=prefetch, lease_s=lease_s,
-            timeout_s=timeout_s).pairs()
+            timeout_s=timeout_s, tenant=tenant).pairs()
 
     def wait_for_starting_new_task_routed(self, env_digest: str, *,
                                           min_version: int = 0,
@@ -389,6 +394,7 @@ class FederationRouter:
                                           lease_s: float = 15.0,
                                           timeout_s: float = 5.0,
                                           home: Optional[int] = None,
+                                          tenant: str = "",
                                           ) -> RoutedGrants:
         """Local allocation, with the SPILLOVER rung in front: an
         overloaded home cell forwards the immediate demand to the
@@ -403,7 +409,7 @@ class FederationRouter:
             if peer is not None:
                 got = self._spill_to(peer, env_digest, min_version,
                                      requestor, immediate, lease_s,
-                                     timeout_s)
+                                     timeout_s, tenant=tenant)
                 if got.grants:
                     return got
                 # Peer came up dry (its headroom evaporated): fall
@@ -417,14 +423,15 @@ class FederationRouter:
             out = routed_fn(env_digest, min_version=min_version,
                             requestor=requestor, immediate=immediate,
                             prefetch=prefetch, lease_s=lease_s,
-                            timeout_s=timeout_s, home=home)
+                            timeout_s=timeout_s, home=home,
+                            tenant=tenant)
         else:
             out = RoutedGrants(shard_id=0)
             for gid, loc in local.wait_for_starting_new_task(
                     env_digest, min_version=min_version,
                     requestor=requestor, immediate=immediate,
                     prefetch=prefetch, lease_s=lease_s,
-                    timeout_s=timeout_s):
+                    timeout_s=timeout_s, tenant=tenant):
                 out.grants.append(RoutedGrant(gid, loc, 0, False))
         out.cell_id = self._my_cell
         for g in out.grants:
@@ -504,12 +511,13 @@ class FederationRouter:
 
     def _spill_to(self, peer: CellHandle, env_digest: str,
                   min_version: int, requestor: str, immediate: int,
-                  lease_s: float, timeout_s: float) -> RoutedGrants:
+                  lease_s: float, timeout_s: float,
+                  tenant: str = "") -> RoutedGrants:
         out = RoutedGrants(shard_id=0, cell_id=self._my_cell)
         pairs = peer.dispatcher.wait_for_starting_new_task(
             env_digest, min_version=min_version, requestor=requestor,
             immediate=min(immediate, self._spill_max_batch), prefetch=0,
-            lease_s=lease_s,
+            lease_s=lease_s, tenant=tenant,
             # A spill is a detour on an already-ruled request: give the
             # peer a short slice of the budget so a dry peer cannot eat
             # the whole wait the delegate granted the home cell.

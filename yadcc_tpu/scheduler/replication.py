@@ -230,11 +230,12 @@ class ReplicatingDispatcher:
                                    prefetch: int = 0,
                                    lease_s: float = 15.0,
                                    timeout_s: float = 5.0,
+                                   tenant: str = "",
                                    ) -> List[Tuple[int, str]]:
         pairs = self._inner.wait_for_starting_new_task(
             env_digest, min_version=min_version, requestor=requestor,
             immediate=immediate, prefetch=prefetch, lease_s=lease_s,
-            timeout_s=timeout_s)
+            timeout_s=timeout_s, tenant=tenant)
         self._journal_issue(env_digest, requestor, lease_s,
                             [(gid, loc) for gid, loc in pairs])
         return pairs

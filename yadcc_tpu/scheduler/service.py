@@ -77,6 +77,11 @@ class SchedulerService:
         min_daemon_version: int = 0,
         clock: Clock = REAL_CLOCK,
         token_rotation_s: float = _TOKEN_ROTATION_S,
+        # Multi-tenant QoS (doc/tenancy.md): a tenancy.TenancyControl.
+        # When set, WaitForStartingTask requires a verifiable tenant
+        # credential — fail-closed: missing or invalid credentials are
+        # ACCESS_DENIED, never silently downgraded to anonymous.
+        tenancy=None,
     ):
         self.dispatcher = dispatcher
         self.bookkeeper = RunningTaskBookkeeper()
@@ -84,6 +89,7 @@ class SchedulerService:
         self._user_tokens = user_tokens
         self._servant_tokens = servant_tokens
         self._min_version = min_daemon_version
+        self.tenancy = tenancy
         # RPC-side stages of the grant path (<Method>:handler /
         # <Method>:serialize, recorded by rpc.transport.dispatch_frame);
         # the dispatcher's own stage_timer covers queue-wait -> apply.
@@ -117,6 +123,21 @@ class SchedulerService:
         return s
 
     # -- handlers ----------------------------------------------------------
+
+    def _resolve_tenant(self, req):
+        """(tenant_id, tier) for a grant request, or raise.
+
+        Tenancy disabled -> ("", "") — the legacy untenanted path.
+        Tenancy enabled  -> the credential must verify against the
+        serving-token window (fail-closed: absent and invalid are the
+        same ACCESS_DENIED; an attacker must not learn which)."""
+        if self.tenancy is None:
+            return "", ""
+        binding = self.tenancy.authenticate(req.tenant_credential)
+        if binding is None:
+            raise RpcError(api.scheduler.SCHEDULER_STATUS_ACCESS_DENIED,
+                           "valid tenant credential required")
+        return binding.tenant_id, binding.tier
 
     def Heartbeat(self, req, attachment: bytes, ctx: RpcContext):
         if not self._servant_tokens.verify(req.token):
@@ -223,6 +244,10 @@ class SchedulerService:
         resolve_home = getattr(self.dispatcher, "resolve_home", None)
         home = (resolve_home(ctx.peer, req.env_desc.compiler_digest)
                 if resolve_home is not None else None)
+        # Tenancy (doc/tenancy.md): resolve the verified tenant BEFORE
+        # admission — the per-tenant budget and tier shed ride the
+        # admission ruling.
+        tenant, tier = self._resolve_tenant(req)
         # Overload ladder (doc/robustness.md): rule BEFORE the request
         # queues.  Shedding is never silent — LOCAL_ONLY and REJECT
         # answer immediately with an explicit verdict (+ retry-after),
@@ -231,6 +256,7 @@ class SchedulerService:
             immediate=req.immediate_reqs or 1,
             prefetch=req.prefetch_reqs,
             requestor=ctx.peer,
+            tenant=tenant, tier=tier,
             **({} if home is None else {"home": home}))
         if decision.flow != admission.FLOW_NONE:
             resp = api.scheduler.WaitForStartingTaskResponse(
@@ -255,6 +281,7 @@ class SchedulerService:
                 lease_s=lease_ms / 1000.0,
                 timeout_s=wait_ms / 1000.0,
                 home=home,
+                tenant=tenant,
             )
             if not routed.grants:
                 raise RpcError(
@@ -282,6 +309,7 @@ class SchedulerService:
             prefetch=req.prefetch_reqs if decision.prefetch_allowed else 0,
             lease_s=lease_ms / 1000.0,
             timeout_s=wait_ms / 1000.0,
+            tenant=tenant,
         )
         if not grants:
             raise RpcError(
@@ -317,10 +345,12 @@ class SchedulerService:
         resolve_home = getattr(self.dispatcher, "resolve_home", None)
         home = (resolve_home(ctx.peer, req.env_desc.compiler_digest)
                 if resolve_home is not None else None)
+        tenant, tier = self._resolve_tenant(req)
         decision = self.dispatcher.admission_check(
             immediate=req.immediate_reqs or 1,
             prefetch=req.prefetch_reqs,
             requestor=ctx.peer,
+            tenant=tenant, tier=tier,
             **({} if home is None else {"home": home}))
         if decision.flow != admission.FLOW_NONE:
             done(api.scheduler.WaitForStartingTaskResponse(
@@ -368,6 +398,7 @@ class SchedulerService:
                 lease_s=lease_ms / 1000.0,
                 timeout_s=wait_ms / 1000.0,
                 home=home,
+                tenant=tenant,
                 on_done=on_routed,
             )
             return
@@ -393,6 +424,7 @@ class SchedulerService:
             prefetch=req.prefetch_reqs if decision.prefetch_allowed else 0,
             lease_s=lease_ms / 1000.0,
             timeout_s=wait_ms / 1000.0,
+            tenant=tenant,
             on_done=on_done,
         )
 

@@ -362,16 +362,20 @@ class ShardRouter:
 
     def admission_check(self, immediate: int = 1, prefetch: int = 0,
                         requestor: str = "",
+                        tenant: str = "", tier: str = "",
                         home: Optional[int] = None) -> AdmissionDecision:
         """Rule on the HOME shard's ladder — the shard this requestor's
         grants queue on.  Shards shed independently: a hot shard that
         stealing cannot relieve degrades alone instead of dragging the
         healthy ones with it.  Pass ``home`` (from ``resolve_home``)
         when the same request will also take the grant path, so both
-        land on the same shard even for an anonymous requestor."""
+        land on the same shard even for an anonymous requestor.
+        Tenant budget/tier shaping (doc/tenancy.md) rules on the home
+        shard's ledger, the same one the grant path will charge."""
         if home is None:
             home = self.resolve_home(requestor)
-        return self._shards[home].admission_check(immediate, prefetch)
+        return self._shards[home].admission_check(
+            immediate, prefetch, tenant=tenant, tier=tier)
 
     def admission_rung(self) -> int:
         """Max rung over shards — the replication journal and the
@@ -393,11 +397,12 @@ class ShardRouter:
                                    prefetch: int = 0,
                                    lease_s: float = 15.0,
                                    timeout_s: float = 5.0,
+                                   tenant: str = "",
                                    ) -> List[Tuple[int, str]]:
         return self.wait_for_starting_new_task_routed(
             env_digest, min_version=min_version, requestor=requestor,
             immediate=immediate, prefetch=prefetch, lease_s=lease_s,
-            timeout_s=timeout_s).pairs()
+            timeout_s=timeout_s, tenant=tenant).pairs()
 
     def wait_for_starting_new_task_routed(self, env_digest: str, *,
                                           min_version: int = 0,
@@ -407,6 +412,7 @@ class ShardRouter:
                                           lease_s: float = 15.0,
                                           timeout_s: float = 5.0,
                                           home: Optional[int] = None,
+                                          tenant: str = "",
                                           ) -> RoutedGrants:
         """The sharded grant path: steal first when the home shard is
         demonstrably outrun, then the normal PR-2 blocking allocation
@@ -434,7 +440,8 @@ class ShardRouter:
                         break
                     got = self._try_steal(
                         home, env_digest, min_version, requestor,
-                        min(need, self._cfg.max_batch), lease_s)
+                        min(need, self._cfg.max_batch), lease_s,
+                        tenant=tenant)
                     if not got:
                         break
                     for gid, loc, donor in got:
@@ -452,7 +459,7 @@ class ShardRouter:
                     env_digest, min_version=min_version,
                     requestor=requestor, immediate=need,
                     prefetch=prefetch, lease_s=lease_s,
-                    timeout_s=remaining):
+                    timeout_s=remaining, tenant=tenant):
                 out.grants.append(RoutedGrant(gid, loc, home, False))
         return out
 
@@ -464,6 +471,7 @@ class ShardRouter:
             prefetch: int = 0,
             lease_s: float = 15.0,
             timeout_s: float = 5.0,
+            tenant: str = "",
             on_done) -> None:  # ytpu: responder(on_done)
         """Loop-native twin of :meth:`wait_for_starting_new_task`:
         fires ``on_done([(grant_id, location)])`` exactly once.  Its
@@ -472,7 +480,7 @@ class ShardRouter:
         self.submit_wait_for_starting_new_task_routed(
             env_digest, min_version=min_version, requestor=requestor,
             immediate=immediate, prefetch=prefetch, lease_s=lease_s,
-            timeout_s=timeout_s,
+            timeout_s=timeout_s, tenant=tenant,
             on_done=lambda routed: on_done(routed.pairs()))
 
     def submit_wait_for_starting_new_task_routed(
@@ -484,6 +492,7 @@ class ShardRouter:
             lease_s: float = 15.0,
             timeout_s: float = 5.0,
             home: Optional[int] = None,
+            tenant: str = "",
             on_done) -> None:  # ytpu: responder(on_done)
         """Async twin of :meth:`wait_for_starting_new_task_routed`:
         the same steal-first plan, but every wait is a parked
@@ -518,7 +527,7 @@ class ShardRouter:
                 env_digest, min_version=min_version,
                 requestor=requestor, immediate=state["need"],
                 prefetch=prefetch, lease_s=lease_s,
-                timeout_s=remaining, on_done=on_home)
+                timeout_s=remaining, tenant=tenant, on_done=on_home)
 
         steal = False
         if self._cfg.enabled and state["need"] > 0 \
@@ -538,7 +547,7 @@ class ShardRouter:
             self._try_steal_async(
                 home, env_digest, min_version, requestor,
                 min(state["need"], self._cfg.max_batch), lease_s,
-                on_got)
+                tenant, on_got=on_got)
 
         def on_got(got) -> None:
             # A dry/paced/full op ends the steal phase, exactly like
@@ -685,6 +694,7 @@ class ShardRouter:
 
     def _try_steal(self, home: int, env_digest: str, min_version: int,
                    requestor: str, want: int, lease_s: float,
+                   tenant: str = "",
                    ) -> List[Tuple[int, str, int]]:
         """One bounded steal op on behalf of shard `home`; returns
         [(grant_id, servant_location, donor_shard)].  The grants are
@@ -712,7 +722,8 @@ class ShardRouter:
             got = self._shards[donor].wait_for_starting_new_task(
                 env_digest, min_version=min_version, requestor=requestor,
                 immediate=min(want, donor_free), prefetch=0,
-                lease_s=lease_s, timeout_s=cfg.donor_timeout_s)
+                lease_s=lease_s, timeout_s=cfg.donor_timeout_s,
+                tenant=tenant)
             if got:
                 with self._lock:
                     self._stats["stolen_grants"] += len(got)
@@ -731,8 +742,8 @@ class ShardRouter:
 
     def _try_steal_async(self, home: int, env_digest: str,
                          min_version: int, requestor: str, want: int,
-                         lease_s: float,
-                         on_got) -> None:  # ytpu: responder(on_got)
+                         lease_s: float, tenant: str = "",
+                         *, on_got) -> None:  # ytpu: responder(on_got)
         """Async twin of :meth:`_try_steal`: identical pacing /
         channel-bound / donor-pick / stats semantics, but the donor
         wait parks on the donor dispatcher's pending queue instead of
@@ -795,7 +806,7 @@ class ShardRouter:
             env_digest, min_version=min_version, requestor=requestor,
             immediate=min(want, donor_free), prefetch=0,
             lease_s=lease_s, timeout_s=cfg.donor_timeout_s,
-            on_done=on_donor)
+            tenant=tenant, on_done=on_donor)
 
     def _note_dry_locked_free(self, home: int, now: float) -> None:
         with self._lock:

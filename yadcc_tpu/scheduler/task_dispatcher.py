@@ -32,11 +32,15 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..tenancy.budgets import TenantLedger
+from ..tenancy.identity import TenantDirectory
+from ..tenancy.tiers import apply_tier
 from ..utils.clock import REAL_CLOCK, Clock
 from ..utils.logging import get_logger
 from ..utils.stagetimer import StageTimer
 from ..ops.assignment import NO_PICK
-from .admission import AdmissionConfig, AdmissionDecision, OverloadLadder
+from .admission import (FLOW_REJECT, AdmissionConfig, AdmissionDecision,
+                        OverloadLadder)
 from .policy import AssignRequest, DispatchPolicy, EnvRegistry, PoolSnapshot
 
 logger = get_logger("scheduler.dispatcher")
@@ -95,6 +99,10 @@ class _Grant:
     expires_at: float
     zombie_since: Optional[float] = None
     requestor: str = ""
+    # Verified tenant the grant is charged to ("" = untenanted); every
+    # release path credits the tenant ledger through this field, so
+    # per-tenant outstanding counts are exact (doc/tenancy.md).
+    tenant: str = ""
 
 
 class _SnapBuffer:
@@ -142,6 +150,9 @@ class _Pending:
     immediate_left: int
     prefetch_left: int
     deadline: float
+    # Verified tenant this demand is attributed to ("" = untenanted):
+    # queued-demand budgeting and minted-grant attribution key on it.
+    tenant: str = ""
     enqueued_at: float = 0.0
     queue_wait_recorded: bool = False
     first_cycle_done: bool = False
@@ -176,6 +187,10 @@ class TaskDispatcher:
         admission_config: Optional[AdmissionConfig] = None,
         grant_id_start: int = 1,
         grant_id_stride: int = 1,
+        # Multi-tenant QoS (doc/tenancy.md): the directory carries
+        # per-tenant budgets and tiers; None = untenanted deployment
+        # (every tenant-typed surface degenerates to the legacy path).
+        tenant_directory: Optional[TenantDirectory] = None,
     ):
         self._policy = policy
         self._clock = clock
@@ -251,6 +266,14 @@ class TaskDispatcher:
         self._stats = {"granted": 0, "expired_grants": 0,
                        "zombies_killed": 0,
                        "adopted_grants": 0}  # guarded by: self._lock
+        # Per-tenant grant provenance ("" entries never created); the
+        # tier-inversion and noisy-neighbor scenarios read from here.
+        self._stats_by_tenant: Dict[str, Dict[str, int]] = \
+            {}  # guarded by: self._lock
+        self._tenant_directory = tenant_directory
+        # Outstanding-grant ledger: charged at mint/adopt, released on
+        # EVERY grant exit path (free, zombie kill, servant drop).
+        self.tenant_ledger = TenantLedger(tenant_directory)
 
         # Lease adoption (warm-standby takeover, scheduler/
         # replication.py): journal-replayed grants for servants that
@@ -613,11 +636,14 @@ class TaskDispatcher:
         prefetch: int = 0,
         lease_s: float = 15.0,
         timeout_s: float = 5.0,
+        tenant: str = "",
     ) -> List[Tuple[int, str]]:
         """Blocking allocation; returns [(grant_id, servant_location)].
 
         May return fewer grants than requested (reference semantics).
         Returns [] when no eligible servant frees up within timeout_s.
+        ``tenant`` attributes minted grants to a verified tenant for
+        budget/provenance accounting ("" = untenanted legacy path).
         """
         env_id = self._envs.intern(env_digest)
         if env_id is None:
@@ -630,6 +656,7 @@ class TaskDispatcher:
                 min_version=min_version,
                 requestor_slot=self._requestor_slot_locked(requestor),
                 requestor=requestor,
+                tenant=tenant,
                 lease_s=lease_s,
                 immediate_left=max(0, immediate),
                 prefetch_left=max(0, prefetch),
@@ -675,6 +702,7 @@ class TaskDispatcher:
         prefetch: int = 0,
         lease_s: float = 15.0,
         timeout_s: float = 5.0,
+        tenant: str = "",
         on_done: Callable,
     ) -> None:  # ytpu: responder(on_done)
         """Parked-continuation twin of wait_for_starting_new_task (the
@@ -706,6 +734,7 @@ class TaskDispatcher:
                 min_version=min_version,
                 requestor_slot=self._requestor_slot_locked(requestor),
                 requestor=requestor,
+                tenant=tenant,
                 lease_s=lease_s,
                 immediate_left=max(0, immediate),
                 prefetch_left=max(0, prefetch),
@@ -819,23 +848,75 @@ class TaskDispatcher:
 
     def admission_check(self, immediate: int = 1,
                         prefetch: int = 0,
-                        requestor: str = "") -> AdmissionDecision:
+                        requestor: str = "",
+                        tenant: str = "",
+                        tier: str = "") -> AdmissionDecision:
         """Rule on one grant request BEFORE it queues.  Called by
         SchedulerService.WaitForStartingTask; cheap enough for the
         grant hot path (one cached-capacity read + a pending-list sum
         under the lock, ladder bookkeeping under its leaf lock).
         ``requestor`` exists for surface parity with the shard router
         (which routes the check to the requestor's home shard); a
-        single dispatcher has one ladder and ignores it."""
+        single dispatcher has one ladder and ignores it.
+
+        Tenancy order matters (doc/tenancy.md): the per-tenant budget
+        is ruled on FIRST and answers with a native FLOW_REJECT that
+        never touches the ladder — an over-budget tenant's refused
+        demand must not press the global signal and degrade everyone
+        else.  The ladder rules second, and the tenant's TIER then only
+        ever *escalates* the verdict (apply_tier)."""
         del requestor
         clock = self._clock
         t0 = clock.now()
         with self._lock:
             util, cap = self._utilization_locked(t0)
+            over = (tenant != ""
+                    and self._tenant_over_budget_locked(tenant, immediate))
+        if over:
+            with self._lock:
+                self._bump_tenant_locked(tenant, "rejected_over_budget")
+            decision = AdmissionDecision(
+                rung=self.admission.rung(), flow=FLOW_REJECT,
+                retry_after_ms=500, prefetch_allowed=False, signal=util)
+            self.stage_timer.record("admission", clock.now() - t0)
+            return decision
         decision = self.admission.decide(util, cap, immediate, prefetch,
                                          clock.now())
+        if tenant != "" or tier != "":
+            shaped = apply_tier(decision, tier)
+            if shaped.flow != decision.flow and tenant != "":
+                with self._lock:
+                    self._bump_tenant_locked(tenant, "shed_by_tier")
+            decision = shaped
         self.stage_timer.record("admission", clock.now() - t0)
         return decision
+
+    def _tenant_over_budget_locked(self, tenant: str,
+                                   immediate: int) -> bool:
+        """Budget verdict under the dispatcher lock: outstanding comes
+        from the ledger (exact), queued demand is summed live from the
+        pending table — no shadow counter that could leak on one of the
+        many pending-exit paths."""
+        spec = (self._tenant_directory.get(tenant)
+                if self._tenant_directory is not None else None)
+        if spec is None:
+            return False
+        if spec.max_outstanding and (
+                self.tenant_ledger.outstanding(tenant) + immediate
+                > spec.max_outstanding):
+            return True
+        if spec.max_queued and sum(
+                r.immediate_left for r in self._pending
+                if r.tenant == tenant and not r.abandoned
+                ) >= spec.max_queued:
+            return True
+        return False
+
+    def _bump_tenant_locked(self, tenant: str, counter: str) -> None:
+        per = self._stats_by_tenant.setdefault(
+            tenant, {"granted": 0, "rejected_over_budget": 0,
+                     "shed_by_tier": 0})
+        per[counter] += 1
 
     def admission_rung(self) -> int:
         """Current overload-ladder rung, exported for the replication
@@ -1134,6 +1215,7 @@ class TaskDispatcher:
             env_digest=req.env_digest,
             expires_at=now + req.lease_s,
             requestor=req.requestor,
+            tenant=req.tenant,
         )
         self._next_grant_id += self._grant_id_stride  # ytpu: allow(grant-id-arith)  # THE mint site: stepping by the namespace stride is the one sanctioned id arithmetic outside the helpers
         self._grants[g.grant_id] = g
@@ -1148,6 +1230,9 @@ class TaskDispatcher:
         else:
             req.immediate_left -= 1
         self._stats["granted"] += 1
+        if g.tenant:
+            self.tenant_ledger.charge(g.tenant)
+            self._bump_tenant_locked(g.tenant, "granted")
         return True
 
     # ------------------------------------------------------------------
@@ -1731,6 +1816,8 @@ class TaskDispatcher:
             g = self._grants.pop(gid, None)
             if g is not None:
                 servant.running_grants.discard(gid)
+                if g.tenant:
+                    self.tenant_ledger.release(g.tenant)
         del self._by_location[servant.info.location]
         ip = servant.info.location.rsplit(":", 1)[0]
         slots = self._by_ip.get(ip)
@@ -1750,7 +1837,8 @@ class TaskDispatcher:
             self._pipe_adj[slot] = 0
 
     def _release_grant_locked(self, g: _Grant) -> None:
-        self._grants.pop(g.grant_id, None)
+        if self._grants.pop(g.grant_id, None) is not None and g.tenant:
+            self.tenant_ledger.release(g.tenant)
         servant = self._slots[g.slot] if g.slot < len(self._slots) else None
         if servant is not None and servant.info.location == g.servant_location:
             if g.grant_id in servant.running_grants:
@@ -1814,6 +1902,11 @@ class TaskDispatcher:
                                if g.zombie_since is not None),
                 "pending_requests": len(self._pending),
                 "stats": dict(self._stats),
+                # Per-tenant grant/budget provenance (doc/tenancy.md);
+                # outstanding/queued live in the ledger snapshot.
+                "stats_by_tenant": {k: dict(v) for k, v
+                                    in self._stats_by_tenant.items()},
+                "tenant_budgets": self.tenant_ledger.inspect(),
                 "envs_interned": len(self._envs),
                 # Overload-ladder state (rung, signal, shed counters,
                 # recent transitions) — doc/robustness.md.

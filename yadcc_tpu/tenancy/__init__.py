@@ -1,0 +1,74 @@
+"""Multi-tenant QoS: verified tenant identity, tiers, budgets, and
+cryptographic cache isolation (doc/tenancy.md).
+
+The yadcc lineage trusts one machine room: every daemon that knows the
+rotating serving-daemon token is a peer, and every cache entry is
+readable by anyone who can name its key.  The ROADMAP's "millions of
+users" north star breaks both assumptions — many organizations share
+one fleet, and the determinism that makes fleet-wide cache sharing
+valuable (identical computations hash to identical keys) is exactly
+what makes a cross-tenant cache read a leak.
+
+This package threads a *verified* tenant identity from the client's
+environment to the cache key:
+
+``identity``   per-tenant credentials HMAC-derived from the scheduler's
+               rotating token window (offline-derivable, revoked by
+               window rotation), verified fail-closed at every surface.
+``tiers``      the fairness classes — interactive / batch / best_effort
+               — and the tier x admission-rung shedding matrix.
+``budgets``    per-tenant outstanding-grant, queued-demand, and
+               cache-bytes ledgers.
+``keys``       the tenant-domain cache-key separator: one tenant can
+               neither read nor poison another's entries even with a
+               guessed plaintext key.
+"""
+
+from yadcc_tpu.tenancy.identity import (
+    TIER_BATCH,
+    TIER_BEST_EFFORT,
+    TIER_INTERACTIVE,
+    TenancyControl,
+    TenantBinding,
+    TenantDirectory,
+    TenantSpec,
+    derive_tenant_credential,
+    tenant_key_secret,
+    verify_tenant_credential,
+)
+from yadcc_tpu.tenancy.keys import key_namespace, tenant_scoped_key
+from yadcc_tpu.tenancy.tiers import (
+    TIER_FANOUT_CAPS,
+    TIER_SHED_RUNG,
+    apply_tier,
+    tier_fanout_cap,
+    tier_shed_rung,
+)
+from yadcc_tpu.tenancy.budgets import (
+    CacheBytesLedger,
+    TenantLedger,
+    TenantOverBudget,
+)
+
+__all__ = [
+    "TIER_BATCH",
+    "TIER_BEST_EFFORT",
+    "TIER_FANOUT_CAPS",
+    "TIER_INTERACTIVE",
+    "TIER_SHED_RUNG",
+    "CacheBytesLedger",
+    "TenancyControl",
+    "TenantBinding",
+    "TenantDirectory",
+    "TenantLedger",
+    "TenantOverBudget",
+    "TenantSpec",
+    "apply_tier",
+    "derive_tenant_credential",
+    "key_namespace",
+    "tenant_key_secret",
+    "tenant_scoped_key",
+    "tier_fanout_cap",
+    "tier_shed_rung",
+    "verify_tenant_credential",
+]

@@ -60,6 +60,27 @@ Scenarios (``cluster_sim --scenario <name>|all``):
                      baseline's post-spill hit rate, 0 errors — and
                      must divert to the cold peer once the warm one
                      fills solid (the load term still binds)
+    noisy-neighbor   multi-tenant fairness (doc/tenancy.md): one
+                     adversary tenant fanning demand across 100
+                     client pids against a single-pid victim tenant
+                     on one shared grant queue; the two-level stride
+                     must hold the victim at >= 0.8 of its tenant
+                     share no matter how many pids the adversary
+                     spreads across
+    cache-poisoning  cryptographic cache isolation: an adversary who
+                     KNOWS a victim's plaintext cache key (determinism
+                     makes it guessable) must neither read the
+                     victim's artifact nor plant an entry the victim
+                     will consume; the victim's own fill and read-back
+                     must still work, and the legacy empty-secret
+                     domain must stay byte-identical
+    tier-inversion   tier x rung shedding matrix: drive the ladder to
+                     SHED_OPTIONAL and SPILLOVER with real held
+                     grants; best-effort demand must be refused with
+                     native REJECT+retry-after while interactive
+                     demand keeps MINTING grants at the same rungs —
+                     and the ladder's own LOCAL_ONLY/REJECT verdicts
+                     are never softened for anyone
 
 Each scenario returns a JSON-able dict with its measurements, its SLO
 bounds, and a per-bound pass flag; ``run_matrix`` aggregates them into
@@ -89,7 +110,8 @@ from ..scheduler.admission import (RUNG_NAMES, RUNG_NORMAL, RUNG_REJECT,
 SCENARIO_NAMES = ("wan-jitter", "burst", "flaky-servant", "slow-loris",
                   "oversized-tu", "cache-restart", "overload-ladder",
                   "aot-storm", "cell-kill", "cold-region",
-                  "spill-affinity")
+                  "spill-affinity", "noisy-neighbor", "cache-poisoning",
+                  "tier-inversion")
 
 
 # --------------------------------------------------------------------------
@@ -1627,6 +1649,351 @@ def _scn_spill_affinity_in(tmp: Path, smoke: bool) -> dict:
     return out
 
 
+# --------------------------------------------------------------------------
+# Multi-tenant QoS scenarios (doc/tenancy.md).
+# --------------------------------------------------------------------------
+
+
+def _scn_noisy_neighbor(smoke: bool) -> dict:
+    """One shared FairGrantQueue, two tenants of equal weight: a
+    single-pid victim against an adversary fanning its demand across
+    100 distinct client pids.  Under per-CLIENT stride alone the
+    adversary would draw ~100/101 of the grants; the tenant level of
+    the two-level queue must arbitrate tenants first, so the victim
+    holds >= 0.8 of its half regardless of the fan-out."""
+    from ..daemon.local.fair_admission import FairGrantQueue
+
+    total_grants = 60 if smoke else 300
+    adversary_pids = 100
+    q = FairGrantQueue()
+    counts = {"victim": 0, "adversary": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def consumer(tenant: str, pid: str):
+        while not stop.is_set():
+            g = q.get(pid, 1.0, timeout_s=0.05, tenant=tenant,
+                      tenant_weight=1.0)
+            if g is None:
+                continue
+            with lock:
+                counts[tenant] += 1
+            # A real delegate does work per grant; a tiny hold keeps
+            # every consumer in contention for the next put.
+            time.sleep(0.0005)
+
+    threads = [threading.Thread(
+        target=consumer, args=("victim", "victim-pid"), daemon=True)]
+    # The adversary's demand arrives through many pids but few OS
+    # threads (a make -j storm multiplexed over one box): each thread
+    # rotates through a disjoint slice of the 100 pids.
+    n_adv_threads = 10
+    per = adversary_pids // n_adv_threads
+
+    def adv_consumer(idx: int):
+        pids = [f"adv-{idx}-{i}" for i in range(per)]
+        k = 0
+        while not stop.is_set():
+            g = q.get(pids[k % per], 1.0, timeout_s=0.05,
+                      tenant="adversary", tenant_weight=1.0)
+            k += 1
+            if g is None:
+                continue
+            with lock:
+                counts["adversary"] += 1
+            time.sleep(0.0005)
+
+    threads += [threading.Thread(target=adv_consumer, args=(i,),
+                                 daemon=True)
+                for i in range(n_adv_threads)]
+    for t in threads:
+        t.start()
+    # Grants trickle in one at a time: contention at every hand-out is
+    # what the stride queue arbitrates.
+    for i in range(total_grants):
+        q.put(object())
+        time.sleep(0.002)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with lock:
+            served = counts["victim"] + counts["adversary"]
+        if served >= total_grants or q.qsize() == 0:
+            break
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(timeout=2.0)
+
+    served = counts["victim"] + counts["adversary"]
+    fair_share = served / 2.0
+    victim_share_ratio = (counts["victim"] / fair_share
+                          if fair_share else 0.0)
+    tenant_counts = q.tenant_share_counts()
+    out = {
+        "grants_offered": total_grants,
+        "grants_served": served,
+        "adversary_pids": adversary_pids,
+        "victim_got": counts["victim"],
+        "adversary_got": counts["adversary"],
+        "tenant_share_counts": tenant_counts,
+        "victim_share_ratio": round(victim_share_ratio, 3),
+        "lost_or_hung": total_grants - served - q.qsize(),
+    }
+    slo = {"victim_share_ratio_min": 0.8, "lost_or_hung_max": 0}
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_cache_poisoning(smoke: bool) -> dict:
+    """Cryptographic cache isolation against an adversary who knows
+    the victim's PLAINTEXT key (compilation is deterministic, so key
+    material is guessable from public inputs — tenancy/keys.py).
+
+    Four claims on a real CacheService:
+
+    1. victim's fill actually runs and lands (actually_run == 1);
+    2. cross-namespace read: the adversary probing the plaintext key
+       AND its own scoped derivation of it both miss;
+    3. poison: entries the adversary plants at every key it CAN write
+       are never returned to the victim — the victim's next read still
+       yields its own bytes;
+    4. the legacy empty-secret domain stays byte-identical (scoped key
+       with no secret == plaintext key).
+    """
+    del smoke  # the rig is O(1); nothing to shrink
+    import types
+
+    from ..cache.in_memory_cache import InMemoryCache
+    from ..cache.service import CacheService
+    from ..common.disk_cache import ShardSpec
+    from ..cache.disk_engine import DiskCacheEngine
+    from ..common.token_verifier import TokenVerifier
+    from ..rpc import RpcContext
+    from ..tenancy.budgets import CacheBytesLedger
+    from ..tenancy.keys import key_namespace, tenant_scoped_key
+
+    import shutil
+
+    tmp = Path(tempfile.mkdtemp(prefix="poison_"))
+    ledger = CacheBytesLedger()
+    svc = CacheService(
+        InMemoryCache(1 << 20),
+        DiskCacheEngine([ShardSpec(str(tmp / "l2"), 1 << 20)]),
+        user_tokens=TokenVerifier({"user"}),
+        servant_tokens=TokenVerifier({"servant"}),
+        tenant_bytes=ledger)
+    ctx = RpcContext()
+    ctx.peer = "10.0.0.9:1"
+
+    def put(key: str, value: bytes) -> bool:
+        try:
+            svc.PutEntry(types.SimpleNamespace(token="servant", key=key),
+                         value, ctx)
+            return True
+        except RpcError:
+            return False
+
+    def get(key: str) -> Optional[bytes]:
+        try:
+            svc.TryGetEntry(
+                types.SimpleNamespace(token="user", key=key), b"", ctx)
+            return bytes(ctx.response_attachment)
+        except RpcError:
+            return None
+
+    try:
+        victim_secret = "v" * 64
+        adversary_secret = "a" * 64
+        plain = "ytpu-cxx2-entry-deadbeef"  # guessable: deterministic inputs
+        victim_key = tenant_scoped_key(victim_secret, plain)
+        victim_bytes = b"victim-object-code"
+
+        # 1. Victim compiles and fills (the actually_run=1 of this rig).
+        victim_fill_ok = int(put(victim_key, victim_bytes))
+
+        # 2. Cross-namespace read: plaintext probe and the adversary's own
+        # derivation both miss (it cannot compute victim_key without the
+        # victim's secret).
+        adv_key_guess = tenant_scoped_key(adversary_secret, plain)
+        cross_read_blocked = int(get(plain) is None
+                                 and get(adv_key_guess) is None
+                                 and adv_key_guess != victim_key)
+
+        # 3. Poison: the adversary plants garbage at every key it can
+        # write — the plaintext key and its own scoped domain.  The
+        # victim's next read must still return the victim's bytes.
+        put(plain, b"poison-legacy")
+        put(adv_key_guess, b"poison-scoped")
+        poison_blocked = int(get(victim_key) == victim_bytes)
+
+        # 4. Legacy passthrough: empty secret == plaintext domain,
+        # byte-identical (pre-tenancy entries stay reachable).
+        legacy_ok = int(tenant_scoped_key("", plain) == plain
+                        and get(plain) == b"poison-legacy")
+
+        # Rider: the adversary's namespace is byte-budgeted; a flood stops
+        # at the quota while the victim's namespace is untouched.
+        adv_ns = key_namespace(adv_key_guess)
+        ledger.set_budget(adv_ns, 64)
+        flood_admitted = 0
+        for i in range(8):
+            if put(tenant_scoped_key(adversary_secret, f"flood-{i}"),
+                   b"x" * 32):
+                flood_admitted += 1
+        stats = svc.inspect()
+    finally:
+        svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {
+        "victim_fill_actually_run": victim_fill_ok,
+        "cross_tenant_read_blocked": cross_read_blocked,
+        "poison_blocked": poison_blocked,
+        "legacy_passthrough_ok": legacy_ok,
+        "adversary_flood_admitted": flood_admitted,
+        "adversary_flood_attempted": 8,
+        "stats_by_tenant": stats["stats_by_tenant"],
+        "tenant_bytes": stats["tenant_bytes"],
+    }
+    slo = {
+        "victim_fill_actually_run_min": 1,
+        "cross_tenant_read_blocked_min": 1,
+        "poison_blocked_min": 1,
+        "legacy_passthrough_ok_min": 1,
+        # 64-byte budget, 32-byte entries (plus the poison-scoped one
+        # already in the namespace): the flood must be cut off.
+        "adversary_flood_admitted_max": 2,
+    }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
+def _scn_tier_inversion(smoke: bool) -> dict:
+    """Tier x rung matrix on a real TaskDispatcher with held grants.
+
+    Drive the ladder to SHED_OPTIONAL, then SPILLOVER, by holding the
+    pool's capacity and pressing immediate demand.  At each rung,
+    probe all three tiers through the production admission path:
+    best_effort must get a native FLOW_REJECT with a retry-after at
+    SHED_OPTIONAL, batch must join it at SPILLOVER, and interactive
+    must not merely be admitted on paper but actually MINT a grant
+    while the others are being shed."""
+    del smoke  # already O(seconds); the rungs are driven, not waited
+    from ..scheduler.admission import (FLOW_NONE, FLOW_REJECT,
+                                       RUNG_SHED_OPTIONAL, RUNG_SPILLOVER)
+    from ..scheduler.policy import make_policy
+    from ..scheduler.task_dispatcher import ServantInfo, TaskDispatcher
+    from ..tenancy.identity import TenantDirectory, TenantSpec
+
+    directory = TenantDirectory([
+        TenantSpec(tenant_id="live", tier="interactive"),
+        TenantSpec(tenant_id="nightly", tier="batch"),
+        TenantSpec(tenant_id="scavenger", tier="best_effort"),
+    ])
+    d = TaskDispatcher(
+        make_policy("greedy_cpu", max_servants=8, avoid_self=False),
+        max_servants=8, batch_window_s=0.0,
+        admission_config=AdmissionConfig(
+            up_thresholds=(0.5, 0.9, 1e9, 1e9),
+            up_dwell_s=0.0, down_dwell_s=60.0),
+        tenant_directory=directory)
+    env = "e" * 64
+    d.keep_servant_alive(ServantInfo(
+        location="10.0.0.1:8335", version=1, num_processors=8,
+        capacity=4, total_memory=1 << 36, memory_available=1 << 35,
+        env_digests=(env,)), 60.0)
+
+    def probe(tier_tenant: str, tier: str) -> dict:
+        dec = d.admission_check(immediate=1, tenant=tier_tenant,
+                                tier=tier)
+        return {"flow": dec.flow, "rung": dec.rung,
+                "retry_after_ms": dec.retry_after_ms}
+
+    held: List[int] = []
+    results: Dict[str, dict] = {}
+    granted_under_shed = 0
+    try:
+        # Baseline: NORMAL admits everyone.
+        results["normal"] = {
+            t: probe(n, t) for n, t in (("live", "interactive"),
+                                        ("nightly", "batch"),
+                                        ("scavenger", "best_effort"))}
+
+        # Hold half the pool: utilization 0.5 >= threshold 0.5 ->
+        # SHED_OPTIONAL (dwell 0 makes the climb immediate).
+        held += [g for g, _ in d.wait_for_starting_new_task(
+            env, immediate=2, timeout_s=5.0, tenant="live")]
+        for _ in range(8):
+            if d.admission_check(immediate=2).rung \
+                    >= RUNG_SHED_OPTIONAL:
+                break
+            time.sleep(0.02)
+        results["shed_optional"] = {
+            t: probe(n, t) for n, t in (("live", "interactive"),
+                                        ("nightly", "batch"),
+                                        ("scavenger", "best_effort"))}
+        # Interactive does not just pass the check — it mints.
+        got = d.wait_for_starting_new_task(
+            env, immediate=1, timeout_s=5.0, tenant="live")
+        granted_under_shed += len(got)
+        held += [g for g, _ in got]
+
+        # Interactive mints AGAIN (the whole pool is now held by the
+        # protected tier), pushing utilization to 1.0 >= 0.9 ->
+        # SPILLOVER.  Pressure from refused probes alone cannot climb
+        # this rung: only real held grants count while nothing sheds.
+        got = d.wait_for_starting_new_task(
+            env, immediate=1, timeout_s=5.0, tenant="live")
+        granted_under_shed += len(got)
+        held += [g for g, _ in got]
+        for _ in range(8):
+            if d.admission_check(immediate=4).rung >= RUNG_SPILLOVER:
+                break
+            time.sleep(0.02)
+        results["spillover"] = {
+            t: probe(n, t) for n, t in (("live", "interactive"),
+                                        ("nightly", "batch"),
+                                        ("scavenger", "best_effort"))}
+        by_tenant = d.inspect()["stats_by_tenant"]
+    finally:
+        d.free_task(held)
+        d.stop()
+
+    def ok(phase: str, tier: str, flow: int) -> bool:
+        return results[phase][tier]["flow"] == flow
+
+    matrix_ok = int(
+        all(ok("normal", t, FLOW_NONE)
+            for t in ("interactive", "batch", "best_effort"))
+        and ok("shed_optional", "interactive", FLOW_NONE)
+        and ok("shed_optional", "batch", FLOW_NONE)
+        and ok("shed_optional", "best_effort", FLOW_REJECT)
+        and results["shed_optional"]["best_effort"]["retry_after_ms"] > 0
+        and ok("spillover", "interactive", FLOW_NONE)
+        and ok("spillover", "batch", FLOW_REJECT)
+        and ok("spillover", "best_effort", FLOW_REJECT))
+    out = {
+        "probes": results,
+        "tier_matrix_ok": matrix_ok,
+        "interactive_granted_under_shed": granted_under_shed,
+        "best_effort_shed_count":
+            by_tenant.get("scavenger", {}).get("shed_by_tier", 0),
+        "batch_shed_count":
+            by_tenant.get("nightly", {}).get("shed_by_tier", 0),
+        "stats_by_tenant": by_tenant,
+    }
+    slo = {
+        "tier_matrix_ok_min": 1,
+        "interactive_granted_under_shed_min": 2,
+        "best_effort_shed_count_min": 2,
+        "batch_shed_count_min": 1,
+    }
+    out["slo"] = slo
+    out["slo_checks"] = _check_slo(out, slo)
+    return out
+
+
 def run_scenario(name: str, smoke: bool = False) -> dict:
     fn = {
         "wan-jitter": _scn_wan_jitter,
@@ -1640,6 +2007,9 @@ def run_scenario(name: str, smoke: bool = False) -> dict:
         "cell-kill": _scn_cell_kill,
         "cold-region": _scn_cold_region,
         "spill-affinity": _scn_spill_affinity,
+        "noisy-neighbor": _scn_noisy_neighbor,
+        "cache-poisoning": _scn_cache_poisoning,
+        "tier-inversion": _scn_tier_inversion,
     }[name]
     out = fn(smoke)
     out["scenario"] = name
@@ -1697,6 +2067,24 @@ def quick_coldregion_metrics() -> dict:
     return {
         "l3_read_through_hit_rate": cold["prefetch_off"]["final_hit_rate"],
         "prefetch_time_to_warm_s": cold["prefetch_on"]["time_to_warm_s"],
+    }
+
+
+def quick_tenancy_metrics() -> dict:
+    """bench.py harness v15 canaries from the tenancy scenarios: the
+    victim tenant's fair-share ratio under a 100-pid noisy neighbor
+    (1.0 = exact half of the shared queue) and a single bit proving
+    cryptographic cache isolation held — the adversary's plaintext and
+    own-derivation reads both missed, its planted entries were never
+    served to the victim, and the victim's fill genuinely ran first."""
+    noisy = run_scenario("noisy-neighbor", smoke=True)
+    poison = run_scenario("cache-poisoning", smoke=True)
+    return {
+        "victim_tenant_slo_share": noisy["victim_share_ratio"],
+        "cross_tenant_isolation_ok": int(
+            bool(poison["victim_fill_actually_run"])
+            and bool(poison["cross_tenant_read_blocked"])
+            and bool(poison["poison_blocked"])),
     }
 
 
